@@ -1,0 +1,25 @@
+"""dwt_tpu — TPU-native framework for feature-whitening domain adaptation.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``roysubhankar/dwt-domain-adaptation`` (CVPR 2019: "Unsupervised Domain
+Adaptation using Feature-Whitening and Consensus Loss").
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+- ``dwt_tpu.ops``      — functional compute ops: grouped Cholesky whitening,
+  stat-injectable batch norm, entropy / min-entropy-consensus losses.
+- ``dwt_tpu.nn``       — Flax modules: multi-branch domain norms, LeNetDWT,
+  ResNetDWT (50/101). NHWC layout, bf16-friendly, jit-able train/eval paths.
+- ``dwt_tpu.data``     — numpy/PIL input pipelines with dual-view target
+  streams and threaded host-side prefetch.
+- ``dwt_tpu.train``    — jitted train/eval steps, schedules, optimizers,
+  stat-collection protocol, Orbax checkpointing.
+- ``dwt_tpu.parallel`` — device mesh + sharding (DP over ICI, pmean moment
+  semantics), multi-host init.
+- ``dwt_tpu.convert``  — PyTorch checkpoint → Flax tree converter.
+- ``dwt_tpu.cli``      — entrypoints mirroring the reference flag surfaces.
+"""
+
+__version__ = "0.1.0"
+
+from dwt_tpu import ops  # noqa: F401

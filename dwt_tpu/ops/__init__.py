@@ -1,0 +1,22 @@
+"""Functional compute ops (pure, jit-able, differentiable)."""
+
+from dwt_tpu.ops.whitening import (  # noqa: F401
+    WhiteningStats,
+    init_whitening_stats,
+    group_whiten,
+    group_cov,
+    whitening_matrix,
+    apply_whitening,
+)
+from dwt_tpu.ops.batch_norm import (  # noqa: F401
+    BatchNormStats,
+    init_batch_norm_stats,
+    batch_norm,
+)
+from dwt_tpu.ops.losses import (  # noqa: F401
+    entropy_loss,
+    mec_loss,
+    nll_loss,
+    softmax_cross_entropy,
+    accuracy,
+)

@@ -1,0 +1,98 @@
+"""Stat-injectable batch normalization as a pure functional op.
+
+Re-provides the behavior of the reference's vendored BN
+(``utils/batch_norm.py:14-88``) — whose one reason to exist is that running
+buffers can be *injected* (seeded from a checkpoint) — in functional form:
+stats are explicit inputs/outputs, so "injection" is just passing a different
+``BatchNormStats`` value. Semantics matched:
+
+* normalization uses the biased batch variance in training and the running
+  variance in eval (``batch_norm.py:66-69`` → ``F.batch_norm`` semantics);
+* the running-variance EMA accumulates the UNBIASED batch variance
+  (torch ``F.batch_norm`` internal update convention);
+* EMA convention ``running <- momentum*new + (1-momentum)*running`` with
+  momentum weighting the new value (``batch_norm.py:114-120`` docstring);
+* ``momentum=None`` selects the cumulative-average mode driven by
+  ``num_batches_tracked`` (``batch_norm.py:61-64``);
+* affine γ/β are NOT part of this op — the models share one γ/β across
+  domain branches (e.g. ``usps_mnist.py:214-215`` pairs with shared
+  ``gamma3/beta3``), so the affine lives in the module layer.
+
+Works on any channels-last input (``[N, C]`` or ``[N, H, W, C]``): moments
+reduce over all leading axes. ``axis_name`` gives cross-replica pmean moments
+for data parallelism (SURVEY §5 distributed backend note).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class BatchNormStats(NamedTuple):
+    mean: jax.Array  # [C] float32
+    var: jax.Array  # [C] float32
+    count: jax.Array  # [] int32 — num_batches_tracked (cumulative mode)
+
+
+def init_batch_norm_stats(num_features: int, dtype=jnp.float32) -> BatchNormStats:
+    return BatchNormStats(
+        mean=jnp.zeros((num_features,), dtype),
+        var=jnp.ones((num_features,), dtype),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def batch_norm(
+    x: jax.Array,
+    stats: BatchNormStats,
+    *,
+    train: bool,
+    momentum: Optional[float] = 0.1,
+    eps: float = 1e-5,
+    axis_name: Optional[str] = None,
+) -> Tuple[jax.Array, BatchNormStats]:
+    """Normalize channels-last ``x``; returns ``(y, new_stats)``.
+
+    ``momentum=None`` → cumulative average factor ``1/count`` like the
+    reference's ``batch_norm.py:61-64``.
+    """
+    xf = x.astype(jnp.float32)
+    if train:
+        reduce_axes = tuple(range(x.ndim - 1))
+        n = 1
+        for a in reduce_axes:
+            n *= x.shape[a]
+        m = jnp.mean(xf, axis=reduce_axes)
+        msq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        if axis_name is not None:
+            m = lax.pmean(m, axis_name)
+            msq = lax.pmean(msq, axis_name)
+            n = n * lax.psum(1, axis_name)
+        var = msq - jnp.square(m)  # biased — used for normalization
+        y = (xf - m) * lax.rsqrt(var + eps)
+
+        count = stats.count + 1
+        if momentum is None:
+            factor = 1.0 / count.astype(jnp.float32)
+        else:
+            factor = jnp.float32(momentum)
+        # Unbiased variance feeds the EMA (torch F.batch_norm convention).
+        unbiased = var * (n / max(n - 1, 1))
+        new_stats = BatchNormStats(
+            mean=(
+                factor * lax.stop_gradient(m) + (1.0 - factor) * stats.mean
+            ),
+            var=(
+                factor * lax.stop_gradient(unbiased)
+                + (1.0 - factor) * stats.var
+            ),
+            count=count,
+        )
+        return y.astype(x.dtype), new_stats
+    else:
+        y = (xf - stats.mean) * lax.rsqrt(stats.var + eps)
+        return y.astype(x.dtype), stats

@@ -1,0 +1,65 @@
+"""Domain-adaptation losses (closed forms from SURVEY §2.2 rows 3-4).
+
+All losses compute in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def entropy_loss(logits: jax.Array) -> jax.Array:
+    """Mean Shannon entropy of softmax predictions.
+
+    ``-mean_n sum_k p_nk log p_nk`` — the target-entropy-minimization term of
+    the digits experiment (reference ``usps_mnist.py:183-194``).
+    """
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    return -jnp.mean(jnp.sum(p * logp, axis=-1))
+
+
+def mec_loss(logits_a: jax.Array, logits_b: jax.Array) -> jax.Array:
+    """Min-Entropy Consensus loss between two views of the target batch.
+
+    Per sample: ``min_k 0.5 * (-log p_a(k) - log p_b(k))``, then batch mean
+    (reference ``utils/consensus_loss.py:11-24``).
+    """
+    la = jax.nn.log_softmax(logits_a.astype(jnp.float32), axis=-1)
+    lb = jax.nn.log_softmax(logits_b.astype(jnp.float32), axis=-1)
+    per_class = 0.5 * (-la - lb)  # [N, K]
+    return jnp.mean(jnp.min(per_class, axis=-1))
+
+
+def nll_loss(
+    log_probs: jax.Array, labels: jax.Array, reduction: str = "mean"
+) -> jax.Array:
+    """Negative log likelihood of integer ``labels`` under ``log_probs``."""
+    picked = jnp.take_along_axis(
+        log_probs.astype(jnp.float32), labels[:, None], axis=-1
+    )[:, 0]
+    if reduction == "mean":
+        return -jnp.mean(picked)
+    if reduction == "sum":
+        return -jnp.sum(picked)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def softmax_cross_entropy(
+    logits: jax.Array, labels: jax.Array, reduction: str = "mean"
+) -> jax.Array:
+    """``nll(log_softmax(logits), labels)`` — the reference's cls loss
+    (``usps_mnist.py:298``, ``resnet50_dwt_mec_officehome.py:425``)."""
+    return nll_loss(
+        jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+        labels,
+        reduction,
+    )
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fraction of argmax predictions equal to ``labels`` (float32)."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))

@@ -1,0 +1,86 @@
+"""Parity tests for the functional BN op against torch.nn.functional.batch_norm
+(the reference delegates to it at ``utils/batch_norm.py:66-69``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+from dwt_tpu.ops import BatchNormStats, batch_norm, init_batch_norm_stats
+
+
+def run_torch(x_nchw, rm, rv, train, momentum=0.1, eps=1e-5):
+    rm_t = torch.tensor(rm.copy())
+    rv_t = torch.tensor(rv.copy())
+    y = F.batch_norm(
+        torch.tensor(x_nchw), rm_t, rv_t, weight=None, bias=None,
+        training=train, momentum=momentum, eps=eps,
+    )
+    return y.numpy(), rm_t.numpy(), rv_t.numpy()
+
+
+def to_nhwc(x_nchw):
+    return np.transpose(x_nchw, (0, 2, 3, 1))
+
+
+def from_nhwc(x_nhwc):
+    return np.transpose(x_nhwc, (0, 3, 1, 2))
+
+
+def test_train_matches_torch_2d():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(6, 5, 4, 4)).astype(np.float32) * 3 + 1
+    rm = rng.normal(size=5).astype(np.float32)
+    rv = rng.uniform(0.5, 2.0, size=5).astype(np.float32)
+    ty, trm, trv = run_torch(x, rm, rv, train=True)
+    stats = BatchNormStats(jnp.asarray(rm), jnp.asarray(rv), jnp.zeros((), jnp.int32))
+    y, ns = batch_norm(jnp.asarray(to_nhwc(x)), stats, train=True)
+    np.testing.assert_allclose(from_nhwc(np.asarray(y)), ty, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ns.mean), trm, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns.var), trv, rtol=1e-4, atol=1e-5)
+
+
+def test_eval_matches_torch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3, 8, 2, 2)).astype(np.float32)
+    rm = rng.normal(size=8).astype(np.float32)
+    rv = rng.uniform(0.5, 2.0, size=8).astype(np.float32)
+    ty, _, _ = run_torch(x, rm, rv, train=False)
+    stats = BatchNormStats(jnp.asarray(rm), jnp.asarray(rv), jnp.zeros((), jnp.int32))
+    y, ns = batch_norm(jnp.asarray(to_nhwc(x)), stats, train=False)
+    np.testing.assert_allclose(from_nhwc(np.asarray(y)), ty, rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(ns.mean), rm)
+
+
+def test_1d_input_matches_torch():
+    # LeNet FC sites use BatchNorm1d(affine=False) (usps_mnist.py:214-228)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 10)).astype(np.float32)
+    rm = np.zeros(10, np.float32)
+    rv = np.ones(10, np.float32)
+    y_t = F.batch_norm(
+        torch.tensor(x), torch.tensor(rm.copy()), torch.tensor(rv.copy()),
+        training=True, momentum=0.1, eps=1e-5,
+    ).numpy()
+    stats = init_batch_norm_stats(10)
+    y, _ = batch_norm(jnp.asarray(x), stats, train=True)
+    np.testing.assert_allclose(np.asarray(y), y_t, rtol=1e-4, atol=1e-5)
+
+
+def test_cumulative_mode():
+    # momentum=None → factor 1/num_batches_tracked (batch_norm.py:61-64)
+    rng = np.random.default_rng(3)
+    stats = init_batch_norm_stats(4)
+    xs = [rng.normal(size=(8, 4)).astype(np.float32) for _ in range(3)]
+    for i, x in enumerate(xs):
+        _, stats = batch_norm(jnp.asarray(x), stats, train=True, momentum=None)
+        assert int(stats.count) == i + 1
+    # after first batch factor=1 → running == batch stats exactly;
+    # torch equivalent with momentum=None over same sequence:
+    rm = torch.zeros(4)
+    rv = torch.ones(4)
+    bn = torch.nn.BatchNorm1d(4, momentum=None, affine=False)
+    for x in xs:
+        bn(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(stats.mean), bn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.var), bn.running_var.numpy(), rtol=1e-4, atol=1e-5)

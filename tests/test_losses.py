@@ -1,0 +1,73 @@
+"""Loss parity tests vs torch implementations of the reference formulas
+(``utils/consensus_loss.py:11-24``, ``usps_mnist.py:188-194,298``)."""
+
+import numpy as np
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+from dwt_tpu.ops import (
+    accuracy,
+    entropy_loss,
+    mec_loss,
+    nll_loss,
+    softmax_cross_entropy,
+)
+
+
+def torch_entropy(x):
+    p = F.softmax(torch.tensor(x), dim=1)
+    q = F.log_softmax(torch.tensor(x), dim=1)
+    return float(-1.0 * (p * q).sum(-1).mean())
+
+
+def torch_mec(x, y, num_classes):
+    i = torch.eye(num_classes).unsqueeze(0)
+    lx = F.log_softmax(torch.tensor(x), dim=1).unsqueeze(-1)
+    ly = F.log_softmax(torch.tensor(y), dim=1).unsqueeze(-1)
+    ce_x = (-1.0 * i * lx).sum(1)
+    ce_y = (-1.0 * i * ly).sum(1)
+    return float((0.5 * (ce_x + ce_y)).min(1)[0].mean())
+
+
+def test_entropy_loss():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 10)).astype(np.float32) * 3
+    assert abs(float(entropy_loss(jnp.asarray(x))) - torch_entropy(x)) < 1e-5
+
+
+def test_mec_loss():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(18, 65)).astype(np.float32) * 2
+    y = rng.normal(size=(18, 65)).astype(np.float32) * 2
+    assert abs(float(mec_loss(jnp.asarray(x), jnp.asarray(y))) - torch_mec(x, y, 65)) < 1e-5
+
+
+def test_mec_loss_closed_form_tiny():
+    # one sample, two classes: min_k 0.5*(-log pa(k) - log pb(k))
+    a = np.array([[0.0, 0.0]], np.float32)  # uniform → -log p = log 2
+    b = np.array([[0.0, 0.0]], np.float32)
+    expected = np.log(2.0)
+    assert abs(float(mec_loss(jnp.asarray(a), jnp.asarray(b))) - expected) < 1e-6
+
+
+def test_cls_loss_and_nll():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16)
+    t = float(F.nll_loss(F.log_softmax(torch.tensor(x), dim=1), torch.tensor(labels)))
+    assert abs(float(softmax_cross_entropy(jnp.asarray(x), jnp.asarray(labels))) - t) < 1e-4
+    t_sum = float(
+        F.nll_loss(F.log_softmax(torch.tensor(x), dim=1), torch.tensor(labels), reduction="sum")
+    )
+    got = float(
+        nll_loss(jnp.asarray(np.log(np.exp(x) / np.exp(x).sum(-1, keepdims=True) + 1e-30)),
+                 jnp.asarray(labels), reduction="sum")
+    )
+    assert abs(got - t_sum) < 1e-2
+
+
+def test_accuracy():
+    logits = jnp.asarray([[2.0, 1.0], [0.0, 3.0], [1.0, 0.0]])
+    labels = jnp.asarray([0, 1, 1])
+    assert abs(float(accuracy(logits, labels)) - 2.0 / 3.0) < 1e-6

@@ -1,0 +1,189 @@
+"""Golden tests for the grouped whitening op (SURVEY §4.1-4.2).
+
+The numpy "reference implementation" below encodes the math of
+``/root/reference/utils/whitening.py:37-61`` from its formulas (mean →
+center → per-group biased covariance → shrinkage → Cholesky → inverse →
+grouped apply → EMA with momentum on the NEW value).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dwt_tpu.ops import (
+    WhiteningStats,
+    group_whiten,
+    init_whitening_stats,
+)
+
+EPS = 1e-3
+
+
+def ref_whiten_nhwc(x, running_mean, running_cov, group_size, train,
+                    momentum=0.1, eps=EPS):
+    """Numpy reference: channels-last grouped Cholesky whitening."""
+    n, h, w, c = x.shape
+    g = min(c, group_size)
+    ng = c // g
+    if train:
+        m = x.reshape(-1, c).mean(0)
+    else:
+        m = running_mean
+    xn = x - m
+    t = xn.reshape(-1, ng, g)  # [M, G, g]
+    cov = np.einsum("mgc,mgd->gcd", t, t) / t.shape[0]
+    if train:
+        use_cov = (1 - eps) * cov + eps * np.eye(g)
+    else:
+        use_cov = (1 - eps) * running_cov + eps * np.eye(g)
+    li = np.linalg.inv(np.linalg.cholesky(use_cov))  # [G, g, g]
+    y = np.einsum("mgc,gdc->mgd", t, li).reshape(x.shape)
+    if train:
+        new_mean = momentum * x.reshape(-1, c).mean(0) + (1 - momentum) * running_mean
+        new_cov = momentum * cov + (1 - momentum) * running_cov
+        return y, new_mean, new_cov
+    return y, running_mean, running_cov
+
+
+def make_input(shape=(4, 5, 5, 8), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=shape).astype(np.float32) * 2.0 + 0.5
+
+
+def test_train_output_matches_reference_math():
+    x = make_input()
+    stats = init_whitening_stats(8, 4)
+    y, new_stats = group_whiten(
+        x, stats, group_size=4, train=True
+    )
+    ref_y, ref_mean, ref_cov = ref_whiten_nhwc(
+        x, np.zeros(8), np.ones((2, 4, 4)), 4, train=True
+    )
+    np.testing.assert_allclose(np.asarray(y), ref_y, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_stats.mean), ref_mean, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_stats.cov), ref_cov, rtol=1e-4, atol=1e-5)
+
+
+def test_output_has_identity_group_covariance():
+    x = make_input((16, 7, 7, 16), seed=3)
+    stats = init_whitening_stats(16, 4)
+    y, _ = group_whiten(x, stats, group_size=4, train=True)
+    y = np.asarray(y, dtype=np.float64)
+    m = y.reshape(-1, 16).mean(0)
+    t = (y - m).reshape(-1, 4, 4)
+    cov = np.einsum("mgc,mgd->gcd", t, t) / t.shape[0]
+    for gi in range(4):
+        np.testing.assert_allclose(cov[gi], np.eye(4), atol=5e-3)
+
+
+def test_eval_uses_running_stats_with_reshrinkage():
+    x = make_input(seed=5)
+    rng = np.random.default_rng(7)
+    a = rng.normal(size=(2, 4, 4))
+    run_cov = (a @ a.transpose(0, 2, 1) + 3 * np.eye(4)).astype(np.float32)
+    run_mean = rng.normal(size=8).astype(np.float32)
+    stats = WhiteningStats(mean=jnp.asarray(run_mean), cov=jnp.asarray(run_cov))
+    y, out_stats = group_whiten(x, stats, group_size=4, train=False)
+    ref_y, _, _ = ref_whiten_nhwc(x, run_mean, run_cov, 4, train=False)
+    np.testing.assert_allclose(np.asarray(y), ref_y, rtol=1e-4, atol=1e-4)
+    # eval must not touch the stats
+    np.testing.assert_array_equal(np.asarray(out_stats.mean), run_mean)
+    np.testing.assert_array_equal(np.asarray(out_stats.cov), run_cov)
+
+
+def test_ema_accumulates_unshrunk_cov_with_momentum_on_new():
+    x = make_input(seed=11)
+    run_mean = np.full(8, 0.25, np.float32)
+    run_cov = np.tile(np.eye(4, dtype=np.float32) * 2, (2, 1, 1))
+    stats = WhiteningStats(mean=jnp.asarray(run_mean), cov=jnp.asarray(run_cov))
+    mom = 0.3
+    _, new_stats = group_whiten(x, stats, group_size=4, train=True, momentum=mom)
+    batch_mean = x.reshape(-1, 8).mean(0)
+    xn = x - batch_mean
+    t = xn.reshape(-1, 2, 4)
+    batch_cov = np.einsum("mgc,mgd->gcd", t, t) / t.shape[0]  # UNSHRUNK
+    np.testing.assert_allclose(
+        np.asarray(new_stats.mean), mom * batch_mean + (1 - mom) * run_mean,
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_stats.cov), mom * batch_cov + (1 - mom) * run_cov,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_gradients_flow_and_match_finite_differences():
+    x64 = make_input((2, 3, 3, 4), seed=13).astype(np.float64)
+
+    with jax.enable_x64(True):
+        stats = WhiteningStats(
+            mean=jnp.zeros(4, jnp.float64),
+            cov=jnp.ones((1, 4, 4), jnp.float64),
+        )
+
+        def f(x):
+            y, _ = group_whiten(x, stats, group_size=4, train=True)
+            return jnp.sum(jnp.sin(y))
+
+        g = jax.grad(f)(jnp.asarray(x64))
+        fd = np.zeros_like(x64)
+        h = 1e-6
+        base = float(f(jnp.asarray(x64)))
+        flat = x64.reshape(-1)
+        for i in range(0, flat.size, 7):  # sample of coordinates
+            pert = flat.copy()
+            pert[i] += h
+            fd.reshape(-1)[i] = (float(f(jnp.asarray(pert.reshape(x64.shape)))) - base) / h
+        idx = np.arange(0, flat.size, 7)
+        np.testing.assert_allclose(
+            np.asarray(g).reshape(-1)[idx], fd.reshape(-1)[idx],
+            rtol=1e-3, atol=1e-4,
+        )
+
+
+def test_group_size_clamped_to_num_features():
+    # reference: group_size = min(num_features, group_size) (whitening.py:14)
+    x = make_input((4, 3, 3, 8), seed=17)
+    stats = init_whitening_stats(8, 32)
+    assert stats.cov.shape == (1, 8, 8)
+    y, _ = group_whiten(x, stats, group_size=32, train=True)
+    assert y.shape == x.shape
+
+
+def test_indivisible_group_size_raises():
+    with pytest.raises(ValueError):
+        init_whitening_stats(6, 4)
+
+
+def test_bf16_activations_use_f32_stats():
+    x = make_input((8, 5, 5, 8), seed=19)
+    stats = init_whitening_stats(8, 4)
+    y16, s16 = group_whiten(
+        jnp.asarray(x, jnp.bfloat16), stats, group_size=4, train=True
+    )
+    assert y16.dtype == jnp.bfloat16
+    assert s16.mean.dtype == jnp.float32
+    assert s16.cov.dtype == jnp.float32
+    y32, _ = group_whiten(jnp.asarray(x), stats, group_size=4, train=True)
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), atol=0.15
+    )
+
+
+def test_jit_and_grad_compile():
+    x = make_input()
+    stats = init_whitening_stats(8, 4)
+
+    @jax.jit
+    def step(x, stats):
+        def loss(x):
+            y, ns = group_whiten(x, stats, group_size=4, train=True)
+            return jnp.mean(y**2), ns
+
+        (l, ns), g = jax.value_and_grad(loss, has_aux=True)(x)
+        return l, ns, g
+
+    l, ns, g = step(jnp.asarray(x), stats)
+    assert np.isfinite(float(l))
+    assert np.all(np.isfinite(np.asarray(g)))
