@@ -1,0 +1,130 @@
+"""Canonical perf driver: jitted DWT train-step throughput on one chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}``.
+
+The reference publishes no throughput numbers (BASELINE.md) — the baseline
+is established de novo, so ``vs_baseline`` is this run's value normalized by
+``BASELINE_IMGS_PER_SEC`` below (the first recorded TPU number; ratio > 1.0
+means faster than that round's result).
+
+Flagship benchmark: LeNet-DWT digits train step at the reference's batch
+size (32 source + 32 target, ``usps_mnist.py:333-336``), group_size=4.
+Selectable with ``--model resnet50`` once the ResNet path lands to measure
+the OfficeHome configuration (18/18/18 thirds, ``resnet50…py:500-502``).
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# First real-TPU measurement (round 2, LeNet-DWT bs32, TPU v5e via axon).
+# Update only to re-anchor; vs_baseline compares against this.
+BASELINE_IMGS_PER_SEC = None  # set after first TPU run; None -> vs_baseline=1.0
+
+
+def _bench_lenet(steps: int, batch: int):
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
+
+    rng = np.random.default_rng(0)
+    b = {
+        "source_x": jnp.asarray(
+            rng.normal(size=(batch, 28, 28, 1)), jnp.float32
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(batch,))),
+        "target_x": jnp.asarray(
+            rng.normal(size=(batch, 28, 28, 1)), jnp.float32
+        ),
+    }
+    model = LeNetDWT(group_size=4)
+    tx = adam_l2(1e-3, 5e-4)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.stack([b["source_x"], b["target_x"]]), tx
+    )
+    step = jax.jit(make_digits_train_step(model, tx, 0.1), donate_argnums=0)
+    return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
+
+
+def _bench_resnet50(steps: int, batch: int):
+    from dwt_tpu.nn import ResNetDWT
+    from dwt_tpu.train import (
+        create_train_state,
+        make_officehome_train_step,
+        sgd_two_group,
+    )
+
+    rng = np.random.default_rng(0)
+    b = {
+        "source_x": jnp.asarray(
+            rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 65, size=(batch,))),
+        "target_x": jnp.asarray(
+            rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16
+        ),
+        "target_aug_x": jnp.asarray(
+            rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16
+        ),
+    }
+    model = ResNetDWT.resnet50(num_classes=65, dtype=jnp.bfloat16)
+    tx = sgd_two_group(1e-2, 1e-3)
+    sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    step = jax.jit(
+        make_officehome_train_step(model, tx, 0.1), donate_argnums=0
+    )
+    return _time_steps(step, state, b, steps, imgs_per_step=3 * batch)
+
+
+def _time_steps(step, state, batch, steps, imgs_per_step):
+    # Warmup: compile + 2 steady-state steps.
+    state, m = step(state, batch)
+    jax.block_until_ready(m)
+    for _ in range(2):
+        state, m = step(state, batch)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(float(m["loss"])), "non-finite loss in bench"
+    return imgs_per_step * steps / dt, dt / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["lenet", "resnet50"], default="lenet")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    if args.model == "lenet":
+        imgs_per_sec, step_time = _bench_lenet(args.steps, args.batch)
+        metric = "lenet_dwt_train_imgs_per_sec"
+    else:
+        imgs_per_sec, step_time = _bench_resnet50(args.steps, max(args.batch, 18))
+        metric = "resnet50_dwt_train_imgs_per_sec"
+
+    vs = 1.0 if BASELINE_IMGS_PER_SEC is None else imgs_per_sec / BASELINE_IMGS_PER_SEC
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(imgs_per_sec, 2),
+                "unit": "imgs/sec",
+                "vs_baseline": round(vs, 4),
+                "step_time_ms": round(step_time * 1e3, 3),
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,13 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 - ``dwt_tpu.cli``      — entrypoints mirroring the reference flag surfaces.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from dwt_tpu import ops  # noqa: F401
+from dwt_tpu import nn  # noqa: F401
+from dwt_tpu import data  # noqa: F401
+from dwt_tpu import train  # noqa: F401
+from dwt_tpu import parallel  # noqa: F401
+from dwt_tpu import convert  # noqa: F401
+from dwt_tpu import utils  # noqa: F401
+from dwt_tpu.config import DigitsConfig, OfficeHomeConfig  # noqa: F401

@@ -1,0 +1,11 @@
+"""dwt_tpu.cli — entrypoints mirroring the reference flag surfaces.
+
+``python -m dwt_tpu.cli.usps_mnist``   ≙ reference ``usps_mnist.py`` CLI
+(``usps_mnist.py:331-349``);
+``python -m dwt_tpu.cli.officehome``   ≙ reference
+``resnet50_dwt_mec_officehome.py`` CLI (``:498-519``).
+
+Extensions over the reference: ``--synthetic`` (generated data, no files),
+``--data_parallel`` (shard over all local devices), ``--ckpt_dir``
+(Orbax save/resume), ``--bf16``, ``--metrics_jsonl``.
+"""

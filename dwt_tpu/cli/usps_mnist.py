@@ -1,0 +1,62 @@
+"""Digits (USPS↔MNIST) entrypoint — reference ``usps_mnist.py:329-404``."""
+
+from __future__ import annotations
+
+import argparse
+
+from dwt_tpu.config import DigitsConfig
+from dwt_tpu.utils import MetricLogger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    d = DigitsConfig()
+    p = argparse.ArgumentParser(description="dwt_tpu digits (DIAL/DWT) trainer")
+    p.add_argument("--num_workers", type=int, default=d.num_workers,
+                   help="prefetch depth (no worker processes in dwt_tpu)")
+    p.add_argument("--source_batch_size", type=int, default=d.source_batch_size)
+    p.add_argument("--target_batch_size", type=int, default=d.target_batch_size)
+    p.add_argument("--test_batch_size", type=int, default=d.test_batch_size)
+    p.add_argument("--source", type=str, default=d.source)
+    p.add_argument("--target", type=str, default=d.target)
+    p.add_argument("--epochs", type=int, default=d.epochs)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--sgd_momentum", type=float, default=d.sgd_momentum,
+                   help="accepted for parity; unused (Adam), as in reference")
+    p.add_argument("--running_momentum", type=float, default=d.running_momentum)
+    p.add_argument("--lambda_entropy_loss", type=float,
+                   default=d.lambda_entropy_loss)
+    p.add_argument("--log_interval", type=int, default=d.log_interval)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--group_size", type=int, default=d.group_size)
+    p.add_argument("--data_root", type=str, default=d.data_root)
+    # dwt_tpu extensions
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--synthetic_size", type=int, default=d.synthetic_size)
+    p.add_argument("--data_parallel", action="store_true")
+    p.add_argument("--ckpt_dir", type=str, default=None)
+    p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--metrics_jsonl", type=str, default=None)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> DigitsConfig:
+    fields = {f.name for f in DigitsConfig.__dataclass_fields__.values()}
+    return DigitsConfig(
+        **{k: v for k, v in vars(args).items() if k in fields}
+    )
+
+
+def main(argv=None) -> float:
+    args = build_parser().parse_args(argv)
+    from dwt_tpu.train.loop import run_digits
+
+    logger = MetricLogger(jsonl_path=args.metrics_jsonl)
+    try:
+        return run_digits(config_from_args(args), logger)
+    finally:
+        logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""dwt_tpu.convert — PyTorch DWT checkpoints → dwt_tpu variable trees."""
+
+from dwt_tpu.convert.torch_resnet import (
+    ConversionReport,
+    convert_resnet_state_dict,
+    load_pytorch_checkpoint,
+)
+
+__all__ = [
+    "ConversionReport",
+    "convert_resnet_state_dict",
+    "load_pytorch_checkpoint",
+]

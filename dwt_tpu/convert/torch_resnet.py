@@ -1,0 +1,217 @@
+"""PyTorch ResNet50-DWT checkpoint → ``ResNetDWT`` variables.
+
+Reproduces the reference's loading pipeline (``resnet50_dwt_mec_officehome
+.py:365-378``) for the published ``model_best_gr_4.pth.tar``:
+
+* the archive is ``{'state_dict': {...}}`` with ``module.``-prefixed keys
+  (DataParallel artifact) — prefix stripped (``:370-373``);
+* whitening sites use ``…bn{k}.wh.running_mean`` (``[1,C,1,1]``) /
+  ``…bn{k}.wh.running_variance`` (``[G,g,g]``) with affines at
+  ``…bn{k}.gamma/beta`` (``[C,1,1]``) — key scheme at ``:76-90``;
+* BN sites use ``…bn{k}.running_mean/running_var`` with affines at
+  ``…bn{k}.weight/bias`` (``:93-105``);
+* downsample norms live at ``layer{L}.0.downsample_bn.*`` (``:181-213``)
+  and the shortcut conv at ``layer{L}.0.downsample.0.weight`` (``:345``);
+* ALL domain branches are seeded from the SAME checkpoint stats and
+  diverge only through their EMAs (``:74-105``; SURVEY §7 quirks) — here:
+  tiled along the leading domain axis;
+* ``strict=False`` semantics (``:376``): checkpoint keys with no (or
+  shape-incompatible) destination are skipped and reported; model leaves
+  the checkpoint doesn't cover keep their fresh init (the reference
+  kaiming-re-inits convs for exactly this case, ``:299-304``).
+
+Layout transforms: conv ``OIHW → HWIO``; linear ``[out,in] → [in,out]``.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class ConversionReport:
+    """What ``strict=False`` would have told you, made explicit."""
+
+    loaded: List[str] = field(default_factory=list)
+    skipped_unexpected: List[str] = field(default_factory=list)
+    skipped_shape_mismatch: List[Tuple[str, tuple, tuple]] = field(
+        default_factory=list
+    )
+
+    def summary(self) -> str:
+        return (
+            f"loaded={len(self.loaded)} "
+            f"unexpected={len(self.skipped_unexpected)} "
+            f"shape_mismatch={len(self.skipped_shape_mismatch)}"
+        )
+
+
+def load_pytorch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Read a ``.pth(.tar)`` archive to numpy, stripping ``module.``."""
+    import torch
+
+    archive = torch.load(path, map_location="cpu", weights_only=False)
+    state_dict = archive.get("state_dict", archive)
+    out = {}
+    for key, value in state_dict.items():
+        if key.startswith("module."):
+            key = key[len("module.") :]
+        out[key] = np.asarray(value.detach().cpu().numpy())
+    return out
+
+
+# torch key (post module.-strip) → (collection, flax path, transform tag)
+_CONV_RE = re.compile(r"^layer(\d+)\.(\d+)\.conv(\d)\.weight$")
+_DOWNSAMPLE_CONV_RE = re.compile(r"^layer(\d+)\.(\d+)\.downsample\.0\.weight$")
+_NORM_RE = re.compile(
+    r"^(?:layer(\d+)\.(\d+)\.)?(bn\d|downsample_bn)\.(.+)$"
+)
+
+# norm-suffix → (collection, leaf path under the dn module, transform)
+_NORM_LEAVES = {
+    # whitening sites (stem + layer1)
+    "wh.running_mean": ("batch_stats", ("whitening", "mean"), "squeeze_tile"),
+    "wh.running_variance": ("batch_stats", ("whitening", "cov"), "tile"),
+    "gamma": ("params", ("gamma",), "squeeze"),
+    "beta": ("params", ("beta",), "squeeze"),
+    # BN sites (layers 2-4)
+    "running_mean": ("batch_stats", ("bn", "mean"), "tile"),
+    "running_var": ("batch_stats", ("bn", "var"), "tile"),
+    "weight": ("params", ("gamma",), "squeeze"),
+    "bias": ("params", ("beta",), "squeeze"),
+    "num_batches_tracked": ("batch_stats", ("bn", "count"), "tile"),
+}
+
+
+def _site_name(bn_name: str) -> str:
+    """Reference norm-site name → dwt module name (``bn1``→``dn1``)."""
+    if bn_name == "downsample_bn":
+        return "downsample_dn"
+    return "dn" + bn_name[len("bn") :]
+
+
+def _resolve(key: str) -> Optional[Tuple[str, Tuple[str, ...], str]]:
+    """Map one torch key to (collection, flax path, transform) or None."""
+    if key == "conv1.weight":
+        return ("params", ("conv1", "kernel"), "conv")
+    m = _CONV_RE.match(key)
+    if m:
+        stage, block, k = m.groups()
+        return (
+            "params",
+            (f"layer{stage}_{block}", f"conv{k}", "kernel"),
+            "conv",
+        )
+    m = _DOWNSAMPLE_CONV_RE.match(key)
+    if m:
+        stage, block = m.groups()
+        return (
+            "params",
+            (f"layer{stage}_{block}", "downsample_conv", "kernel"),
+            "conv",
+        )
+    if key in ("fc_out.weight", "fc.weight"):
+        return ("params", ("fc_out", "kernel"), "linear")
+    if key in ("fc_out.bias", "fc.bias"):
+        return ("params", ("fc_out", "bias"), "none")
+    m = _NORM_RE.match(key)
+    if m:
+        stage, block, bn_name, leaf = m.groups()
+        resolved = _NORM_LEAVES.get(leaf)
+        if resolved is None:
+            return None
+        collection, leaf_path, transform = resolved
+        site = _site_name(bn_name)
+        if stage is None:
+            path = (site,) + leaf_path  # stem: bn1.* → dn1
+        else:
+            path = (f"layer{stage}_{block}", site) + leaf_path
+        return (collection, path, transform)
+    return None
+
+
+def _transform(value: np.ndarray, tag: str, num_domains: int) -> np.ndarray:
+    if tag == "conv":  # OIHW → HWIO
+        return np.transpose(value, (2, 3, 1, 0))
+    if tag == "linear":  # [out, in] → [in, out]
+        return np.transpose(value, (1, 0))
+    if tag == "squeeze":  # [C,1,1] / [1,C] → [C]
+        return value.reshape(-1)
+    if tag == "squeeze_tile":  # [1,C,1,1] → [D, C]
+        flat = value.reshape(-1)
+        return np.broadcast_to(flat, (num_domains,) + flat.shape).copy()
+    if tag == "tile":  # stat of any shape → [D, ...]
+        return np.broadcast_to(value, (num_domains,) + value.shape).copy()
+    return value
+
+
+def _get(tree: Any, path: Tuple[str, ...]) -> Any:
+    node = tree
+    for part in path:
+        if isinstance(node, dict):
+            if part not in node:
+                return None
+            node = node[part]
+        elif hasattr(node, "_fields"):  # NamedTuple stat containers
+            if part not in node._fields:
+                return None
+            node = getattr(node, part)
+        else:
+            return None
+    return node
+
+
+def _set(tree: Any, path: Tuple[str, ...], value: Any) -> Any:
+    """Functional set: returns a copy of ``tree`` with ``path`` replaced."""
+    part, rest = path[0], path[1:]
+    if isinstance(tree, dict):
+        new = dict(tree)
+        new[part] = value if not rest else _set(tree[part], rest, value)
+        return new
+    if hasattr(tree, "_fields"):
+        child = getattr(tree, part)
+        return tree._replace(
+            **{part: value if not rest else _set(child, rest, value)}
+        )
+    raise TypeError(f"cannot descend into {type(tree)} at {part}")
+
+
+def convert_resnet_state_dict(
+    state_dict: Dict[str, np.ndarray],
+    variables: Dict[str, Any],
+    num_domains: int = 3,
+) -> Tuple[Dict[str, Any], ConversionReport]:
+    """Merge a torch DWT state_dict into freshly-initialized variables.
+
+    ``variables`` is ``model.init(...)`` output for a ``ResNetDWT``; returns
+    ``(new_variables, report)`` without mutating the input.
+    """
+    report = ConversionReport()
+    new_vars = {k: v for k, v in variables.items()}
+
+    for key, raw in state_dict.items():
+        resolved = _resolve(key)
+        if resolved is None:
+            report.skipped_unexpected.append(key)
+            continue
+        collection, path, tag = resolved
+        target = _get(new_vars.get(collection, {}), path)
+        if target is None:
+            report.skipped_unexpected.append(key)
+            continue
+        value = _transform(np.asarray(raw), tag, num_domains)
+        if tuple(value.shape) != tuple(target.shape):
+            report.skipped_shape_mismatch.append(
+                (key, tuple(value.shape), tuple(target.shape))
+            )
+            continue
+        value = jax.numpy.asarray(value, dtype=target.dtype)
+        new_vars[collection] = _set(new_vars[collection], path, value)
+        report.loaded.append(key)
+
+    return new_vars, report

@@ -1,0 +1,57 @@
+"""dwt_tpu.data — host-side input pipelines (L1 of SURVEY §1).
+
+Re-provides the reference's data layer — USPS/MNIST digit datasets
+(``usps_mnist.py:26-181``), the ImageFolder walker with the dual-view
+``transform_aug`` triple protocol (``utils/folder.py:58-190,138-147``), and
+the OfficeHome augmentation stack (``resnet50_dwt_mec_officehome.py:481-492,
+527-543``) — as plain numpy/PIL pipelines built for feeding jitted TPU
+steps:
+
+* datasets hand out HWC float32 numpy; batching stacks to NHWC — the TPU's
+  native layout (no NCHW anywhere);
+* no worker processes: decode/augment cost is hidden by a background
+  prefetch thread that overlaps host work with device steps
+  (``prefetch_to_device``), the JAX equivalent of DataLoader workers;
+* per-process sharding for multi-host DP is a ``shard=(index, count)``
+  slice at the sampler, mirroring what DistributedSampler would do.
+"""
+
+from dwt_tpu.data.datasets import (
+    ArrayDataset,
+    ImageFolderDataset,
+    load_mnist,
+    load_usps,
+)
+from dwt_tpu.data.transforms import (
+    Compose,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    Resize,
+    ToArray,
+    gaussian_blur,
+    random_affine,
+)
+from dwt_tpu.data.loader import (
+    batch_iterator,
+    infinite,
+    prefetch_to_device,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "ImageFolderDataset",
+    "load_mnist",
+    "load_usps",
+    "Compose",
+    "Normalize",
+    "RandomCrop",
+    "RandomHorizontalFlip",
+    "Resize",
+    "ToArray",
+    "gaussian_blur",
+    "random_affine",
+    "batch_iterator",
+    "infinite",
+    "prefetch_to_device",
+]

@@ -1,0 +1,152 @@
+"""Image transforms: PIL for geometry, numpy/cv2 for the aug math.
+
+The OfficeHome target-view augmentation stack replicated from the
+reference (``resnet50_dwt_mec_officehome.py:481-492,535-543``): resize →
+random crop → hflip → random affine perturbation → (near-no-op) gaussian
+blur → normalize.  All callables are ``img -> img`` where ``img`` is a PIL
+Image until ``ToArray`` and an HWC float32 numpy array after.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except ImportError:  # pragma: no cover
+    _HAS_CV2 = False
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Resize:
+    """Resize to ``(size, size)`` (PIL bilinear), matching
+    ``transforms.Resize((s, s))`` (``resnet50…py:528``)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, img):
+        from PIL import Image
+
+        return img.resize((self.size, self.size), Image.BILINEAR)
+
+
+class RandomCrop:
+    def __init__(self, size: int, rng: np.random.Generator | None = None):
+        self.size = size
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, img):
+        w, h = img.size
+        if (w, h) == (self.size, self.size):
+            return img
+        left = int(self.rng.integers(0, w - self.size + 1))
+        top = int(self.rng.integers(0, h - self.size + 1))
+        return img.crop((left, top, left + self.size, top + self.size))
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5, rng: np.random.Generator | None = None):
+        self.p = p
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, img):
+        from PIL import Image
+
+        if self.rng.random() < self.p:
+            return img.transpose(Image.FLIP_LEFT_RIGHT)
+        return img
+
+
+class ToArray:
+    """PIL (or numpy) → HWC float32 in [0, 1] — torch ``ToTensor`` minus
+    the NCHW permute (TPU wants channels-last)."""
+
+    def __call__(self, img) -> np.ndarray:
+        a = np.asarray(img, dtype=np.float32)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        if a.max() > 1.5:  # uint8-ranged input
+            a = a / 255.0
+        return a
+
+
+class Normalize:
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, a: np.ndarray) -> np.ndarray:
+        return (a - self.mean) / self.std
+
+
+def random_affine(
+    a: np.ndarray, sigma: float = 0.1, rng: np.random.Generator | None = None
+) -> np.ndarray:
+    """The reference's ``_random_affine_augmentation`` on HWC arrays
+    (``resnet50…py:481-487``): identity 2x3 matrix with N(0, sigma)
+    perturbations, zero translation."""
+    rng = rng or np.random.default_rng()
+    m = np.float32(
+        [
+            [1 + rng.normal(0, sigma), rng.normal(0, sigma), 0],
+            [rng.normal(0, sigma), 1 + rng.normal(0, sigma), 0],
+        ]
+    )
+    h, w = a.shape[:2]
+    if _HAS_CV2:
+        out = cv2.warpAffine(a, m, (w, h))
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out.astype(np.float32)
+    # scipy fallback: affine_transform uses inverse coords, x/y swapped.
+    from scipy import ndimage
+
+    full = np.eye(3, dtype=np.float32)
+    full[:2] = m[[1, 0]][:, [1, 0, 2]]  # swap x/y convention
+    inv = np.linalg.inv(full)
+    out = np.stack(
+        [
+            ndimage.affine_transform(
+                a[..., c], inv[:2, :2], offset=inv[:2, 2], order=1
+            )
+            for c in range(a.shape[-1])
+        ],
+        axis=-1,
+    )
+    return out.astype(np.float32)
+
+
+def gaussian_blur(a: np.ndarray, sigma: float = 0.1) -> np.ndarray:
+    """The reference's ``_gaussian_blur`` (``resnet50…py:489-492``) —
+    ``ksize = int(sigma + 0.5) * 8 + 1``, which is 1 at the default sigma,
+    i.e. deliberately near-no-op; replicated, not 'fixed' (SURVEY §7
+    quirks)."""
+    ksize = int(sigma + 0.5) * 8 + 1
+    if ksize <= 1:
+        return a
+    if _HAS_CV2:
+        out = cv2.GaussianBlur(a, (ksize, ksize), sigma)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out.astype(np.float32)
+    from scipy import ndimage
+
+    out = np.stack(
+        [ndimage.gaussian_filter(a[..., c], sigma) for c in range(a.shape[-1])],
+        axis=-1,
+    )
+    return out.astype(np.float32)

@@ -1,0 +1,43 @@
+"""dwt_tpu.nn — Flax modules for domain-split whitened networks.
+
+TPU-first re-design of the reference's model layer (SURVEY §2.2 rows 5-8).
+The defining pattern of the reference — every norm site has one stat branch
+per domain, sharing a single learnable affine — is generalized here by
+``DomainWhiten`` / ``DomainBatchNorm`` (N branches instead of the hardcoded
+2-branch LeNet / 3-branch ResNet forms).
+
+Batch layout: instead of the reference's concat-then-split-at-every-site
+(``torch.split(x, x.shape[0]//D)`` at each norm, ``usps_mnist.py:235``,
+``resnet50_dwt_mec_officehome.py:220``), training inputs carry an explicit
+leading **domain axis**: ``[D, N, ..., C]``.  This is the shape XLA and the
+sharding layer want — the per-domain batch axis ``N`` shards cleanly over a
+device mesh so every replica holds an equal slice of *every* domain, and the
+per-branch moments ``pmean`` back to the reference's global-batch numerics.
+Convs/matmuls run on the merged ``[D*N, ...]`` batch (one big MXU-friendly
+batch); only the norm sites see the domain structure (via ``vmap`` over
+stacked per-domain stats).  Eval inputs have no domain axis (``[N, ..., C]``)
+and route through the designated ``eval_domain`` branch only, replicating the
+reference's target-branch-only eval forward (``usps_mnist.py:258-277``,
+``resnet50_dwt_mec_officehome.py:241-260``).
+"""
+
+from dwt_tpu.nn.norms import (
+    DomainBatchNorm,
+    DomainWhiten,
+    apply_domain_norm,
+    merge_domains,
+    split_domains,
+)
+from dwt_tpu.nn.lenet import LeNetDWT
+from dwt_tpu.nn.resnet import BottleneckDWT, ResNetDWT
+
+__all__ = [
+    "DomainBatchNorm",
+    "DomainWhiten",
+    "apply_domain_norm",
+    "merge_domains",
+    "split_domains",
+    "LeNetDWT",
+    "BottleneckDWT",
+    "ResNetDWT",
+]

@@ -1,0 +1,116 @@
+"""LeNet-DWT — the digits (USPS↔MNIST) model.
+
+Behavioral spec from the reference ``usps_mnist.py:196-278``: two 5x5 conv
+blocks (1→32→48 channels, whitening norms, 2x2 maxpool) and three FC layers
+(2352→100→100→10, batch-norm sites), every norm site domain-split with a
+shared affine.  Re-designed for TPU:
+
+* NHWC activations; the merged ``[D*N, H, W, C]`` batch feeds the convs so
+  the MXU sees one large batch, and only norm sites see the domain axis
+  (see ``dwt_tpu.nn`` module docstring for the layout rationale);
+* train forward takes ``[D, N, 28, 28, 1]`` (D=2: source, target) — the
+  explicit-domain-axis equivalent of the reference's halves split
+  (``usps_mnist.py:235``); eval forward takes ``[N, 28, 28, 1]`` and routes
+  through the target branches only (``usps_mnist.py:258-277``);
+* the flatten between conv and FC stacks is NHWC-ordered (the torch model
+  flattens NCHW, ``usps_mnist.py:246``) — a weight permutation, not a
+  behavioral difference, since fc3 is trained from scratch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as fnn
+
+from dwt_tpu.nn.norms import (
+    DomainBatchNorm,
+    DomainWhiten,
+    apply_domain_norm,
+    merge_domains,
+    split_domains,
+)
+
+
+class LeNetDWT(fnn.Module):
+    """Dual-branch whitened LeNet for unsupervised domain adaptation."""
+
+    group_size: int = 4
+    num_classes: int = 10
+    num_domains: int = 2
+    eval_domain: int = 1
+    momentum: float = 0.1
+    whiten_eps: float = 1e-3
+    axis_name: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    def _norm(self, x, norm, train):
+        return apply_domain_norm(x, norm, train, self.num_domains)
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        if train:
+            if x.shape[0] != self.num_domains:
+                raise ValueError(
+                    f"train input must be [D={self.num_domains}, N, 28, 28, 1]; "
+                    f"got {x.shape}"
+                )
+            batch_shape = x.shape[:2]
+            x = merge_domains(x)
+        x = x.astype(self.dtype)
+
+        conv_kw = dict(padding="SAME", dtype=self.dtype)
+        norm_kw = dict(
+            num_domains=self.num_domains,
+            eval_domain=self.eval_domain,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+        )
+
+        # Conv block 1: conv → whiten → affine → relu → maxpool
+        # (reference order at usps_mnist.py:238: pool(relu(cat(ws,wt)*g+b)))
+        x = fnn.Conv(32, (5, 5), name="conv1", **conv_kw)(x)
+        x = self._norm(
+            x,
+            DomainWhiten(
+                32, self.group_size, eps=self.whiten_eps, name="dn1", **norm_kw
+            ),
+            train,
+        )
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (2, 2), strides=(2, 2))
+
+        # Conv block 2
+        x = fnn.Conv(48, (5, 5), name="conv2", **conv_kw)(x)
+        x = self._norm(
+            x,
+            DomainWhiten(
+                48, self.group_size, eps=self.whiten_eps, name="dn2", **norm_kw
+            ),
+            train,
+        )
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (2, 2), strides=(2, 2))
+
+        x = x.reshape(x.shape[0], -1)  # [B, 7*7*48 = 2352]
+
+        # FC stack: fc → bn → affine → relu (last layer: no relu)
+        x = fnn.Dense(100, name="fc3", dtype=self.dtype)(x)
+        x = self._norm(x, DomainBatchNorm(100, name="dn3", **norm_kw), train)
+        x = fnn.relu(x)
+
+        x = fnn.Dense(100, name="fc4", dtype=self.dtype)(x)
+        x = self._norm(x, DomainBatchNorm(100, name="dn4", **norm_kw), train)
+        x = fnn.relu(x)
+
+        x = fnn.Dense(self.num_classes, name="fc5", dtype=self.dtype)(x)
+        x = self._norm(
+            x, DomainBatchNorm(self.num_classes, name="dn5", **norm_kw), train
+        )
+
+        if train:
+            x = split_domains(x, self.num_domains)
+            assert x.shape[:2] == batch_shape
+        return x

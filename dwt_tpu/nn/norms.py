@@ -1,0 +1,183 @@
+"""Multi-branch domain normalization modules (whitening / batch norm).
+
+Generalizes the reference's per-domain norm-branch pattern: the 2-branch
+``ws*/wt*`` + shared ``gamma*/beta*`` sites of LeNet (``usps_mnist.py:200-228``)
+and the 3-branch ``bns*/bnt*/bnt*_aug`` sites of the ResNet Bottleneck
+(``resnet50_dwt_mec_officehome.py:73-213``) are both ``num_domains`` instances
+of one stat collection with a single shared affine.
+
+Stats live in the Flax ``batch_stats`` collection, stacked along a leading
+domain axis, so the whole model state is one pytree that jits/shards/scans
+cleanly.  Training applies branch ``d`` to domain slice ``d`` via ``vmap``
+over the stacked stats; eval applies the ``eval_domain`` branch to the whole
+(domain-axis-free) batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as fnn
+
+from dwt_tpu.ops.batch_norm import BatchNormStats, batch_norm, init_batch_norm_stats
+from dwt_tpu.ops.whitening import (
+    WhiteningStats,
+    group_whiten,
+    init_whitening_stats,
+)
+
+
+def merge_domains(x: jax.Array) -> jax.Array:
+    """``[D, N, ...] -> [D*N, ...]`` for the dense/conv compute path."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def split_domains(x: jax.Array, num_domains: int) -> jax.Array:
+    """``[D*N, ...] -> [D, N, ...]`` for the norm sites."""
+    return x.reshape((num_domains, x.shape[0] // num_domains) + x.shape[1:])
+
+
+def apply_domain_norm(x: jax.Array, norm, train: bool, num_domains: int):
+    """Apply a domain norm to a merged ``[D*N, ...]`` training batch (or a
+    plain eval batch): split to the domain layout, normalize, re-merge."""
+    if train:
+        return merge_domains(norm(split_domains(x, num_domains), train))
+    return norm(x, train)
+
+
+def _check_train_input(x: jax.Array, num_domains: int, name: str) -> None:
+    if x.shape[0] != num_domains:
+        raise ValueError(
+            f"{name}: training input must carry a leading domain axis of "
+            f"size num_domains={num_domains}; got shape {x.shape}"
+        )
+
+
+class DomainWhiten(fnn.Module):
+    """``num_domains`` grouped-whitening branches sharing one affine.
+
+    Train input ``[D, N, ..., C]`` → branch ``d`` whitens slice ``d`` with its
+    own running stats (all EMAs advance).  Eval input ``[N, ..., C]`` →
+    ``eval_domain``'s running stats whiten everything, no state change —
+    the reference's target-branch eval routing (``usps_mnist.py:258-277``).
+
+    ``use_affine=True`` matches the models' shared ``gamma/beta`` applied
+    after the branch concat (``usps_mnist.py:202-203``,
+    ``resnet50_dwt_mec_officehome.py:55-57``) — affine after concat and
+    affine per branch are the same computation.
+    """
+
+    features: int
+    group_size: int
+    num_domains: int = 2
+    eval_domain: int = 1
+    momentum: float = 0.1
+    eps: float = 1e-3
+    use_affine: bool = True
+    axis_name: Optional[str] = None
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        proto = init_whitening_stats(self.features, self.group_size)
+        stats_var = self.variable(
+            "batch_stats",
+            "whitening",
+            lambda: jax.tree.map(
+                lambda a: jnp.tile(a, (self.num_domains,) + (1,) * a.ndim), proto
+            ),
+        )
+        stats: WhiteningStats = stats_var.value
+
+        if train:
+            _check_train_input(x, self.num_domains, self.name or "DomainWhiten")
+            whiten = partial(
+                group_whiten,
+                group_size=self.group_size,
+                train=True,
+                momentum=self.momentum,
+                eps=self.eps,
+                axis_name=self.axis_name,
+            )
+            y, new_stats = jax.vmap(whiten)(x, stats)
+            if not self.is_initializing():
+                stats_var.value = new_stats
+        else:
+            branch = jax.tree.map(lambda a: a[self.eval_domain], stats)
+            y, _ = group_whiten(
+                x,
+                branch,
+                group_size=self.group_size,
+                train=False,
+                eps=self.eps,
+            )
+
+        if self.use_affine:
+            gamma = self.param(
+                "gamma", fnn.initializers.ones, (self.features,), jnp.float32
+            )
+            beta = self.param(
+                "beta", fnn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y * gamma.astype(y.dtype) + beta.astype(y.dtype)
+        return y
+
+
+class DomainBatchNorm(fnn.Module):
+    """``num_domains`` stat-injectable BN branches sharing one affine.
+
+    The functional analogue of the reference's paired ``bns*/bnt*``
+    ``BatchNorm1d(affine=False)`` sites with shared ``gamma/beta``
+    (``usps_mnist.py:214-228``) and the ResNet BN triples
+    (``resnet50_dwt_mec_officehome.py:91-105``).  "Stat injection" (the whole
+    reason the reference vendors BN) is just overwriting the ``batch_stats``
+    collection — see ``dwt_tpu.convert``.
+    """
+
+    features: int
+    num_domains: int = 2
+    eval_domain: int = 1
+    momentum: Optional[float] = 0.1
+    eps: float = 1e-5
+    use_affine: bool = True
+    axis_name: Optional[str] = None
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        proto = init_batch_norm_stats(self.features)
+        stats_var = self.variable(
+            "batch_stats",
+            "bn",
+            lambda: jax.tree.map(
+                lambda a: jnp.tile(a, (self.num_domains,) + (1,) * a.ndim), proto
+            ),
+        )
+        stats: BatchNormStats = stats_var.value
+
+        if train:
+            _check_train_input(x, self.num_domains, self.name or "DomainBatchNorm")
+            bn = partial(
+                batch_norm,
+                train=True,
+                momentum=self.momentum,
+                eps=self.eps,
+                axis_name=self.axis_name,
+            )
+            y, new_stats = jax.vmap(bn)(x, stats)
+            if not self.is_initializing():
+                stats_var.value = new_stats
+        else:
+            branch = jax.tree.map(lambda a: a[self.eval_domain], stats)
+            y, _ = batch_norm(x, branch, train=False, eps=self.eps)
+
+        if self.use_affine:
+            gamma = self.param(
+                "gamma", fnn.initializers.ones, (self.features,), jnp.float32
+            )
+            beta = self.param(
+                "beta", fnn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y * gamma.astype(y.dtype) + beta.astype(y.dtype)
+        return y

@@ -1,0 +1,201 @@
+"""ResNet-DWT — the OfficeHome/VisDA backbone with triple domain branches.
+
+Behavioral spec from the reference ``resnet50_dwt_mec_officehome.py``:
+
+* every norm site carries THREE stat branches — source / target /
+  augmented-target (``bns*/bnt*/bnt*_aug``, ``:73-213``) — sharing one
+  affine; training splits the batch in thirds at each site (``:216-240``),
+  eval routes everything through the target branch (``:241-260``);
+* the stem norm and all of stage 1 use grouped whitening (``layer == 1``
+  branches, ``:73-90``); stages 2-4 use stat-injectable BN (``:91-105``);
+* downsample shortcuts are a bare 1x1 conv (no norm inside the Sequential,
+  ``:345-349``) followed by a separate triple-branch norm site
+  (``:181-213``);
+* ``fc_out`` is the ``num_classes`` head (``:297``); conv weights use
+  kaiming/fan_out init and are *not* loaded from the checkpoint
+  (``strict=False`` + re-init, ``:299-304,376``) — only norm stats/affines
+  come from the converted checkpoint (see ``dwt_tpu.convert``).
+
+TPU re-design: NHWC, bf16-ready compute dtype with f32 norm statistics,
+merged ``[D*N, H, W, C]`` batch through convs (MXU-friendly), domain axis
+only at norm sites, depth variants (50/101) via ``stage_sizes`` exactly as
+the reference generalizes via its ``layers`` list (``:264,375``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as fnn
+
+from dwt_tpu.nn.norms import (
+    DomainBatchNorm,
+    DomainWhiten,
+    apply_domain_norm,
+    merge_domains,
+    split_domains,
+)
+
+# kaiming_normal(mode=fan_out, relu) — the reference's conv init
+# (resnet50_dwt_mec_officehome.py:299-301).
+_conv_init = fnn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+class BottleneckDWT(fnn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck, every norm a triple-branch domain site."""
+
+    planes: int
+    stride: int = 1
+    use_whitening: bool = False
+    has_downsample: bool = False
+    group_size: int = 4
+    num_domains: int = 3
+    eval_domain: int = 1
+    momentum: float = 0.1
+    axis_name: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    expansion: int = 4
+
+    def _make_norm(self, features: int, name: str):
+        kw = dict(
+            num_domains=self.num_domains,
+            eval_domain=self.eval_domain,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+            name=name,
+        )
+        if self.use_whitening:
+            return DomainWhiten(features, self.group_size, **kw)
+        return DomainBatchNorm(features, **kw)
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        conv = partial(
+            fnn.Conv, use_bias=False, dtype=self.dtype, kernel_init=_conv_init
+        )
+        norm = lambda h, features, name: apply_domain_norm(
+            h, self._make_norm(features, name), train, self.num_domains
+        )
+        out_ch = self.planes * self.expansion
+
+        identity = x
+        h = conv(self.planes, (1, 1), name="conv1")(x)
+        h = fnn.relu(norm(h, self.planes, "dn1"))
+
+        h = conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                 padding="SAME", name="conv2")(h)
+        h = fnn.relu(norm(h, self.planes, "dn2"))
+
+        h = conv(out_ch, (1, 1), name="conv3")(h)
+        h = norm(h, out_ch, "dn3")
+
+        if self.has_downsample:
+            identity = conv(
+                out_ch,
+                (1, 1),
+                strides=(self.stride, self.stride),
+                name="downsample_conv",
+            )(x)
+            identity = norm(identity, out_ch, "downsample_dn")
+
+        return fnn.relu(h + identity)
+
+
+class ResNetDWT(fnn.Module):
+    """ResNet-50/101 with domain whitening (stem + stage 1) and domain BN.
+
+    Train input ``[3, N, H, W, C]`` (source, target, augmented target) —
+    the explicit-domain-axis form of the reference's thirds split
+    (``resnet50…py:220``); eval input ``[N, H, W, C]`` through target
+    branches only.
+    """
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 65
+    group_size: int = 4
+    num_domains: int = 3
+    eval_domain: int = 1
+    momentum: float = 0.1
+    axis_name: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetDWT":
+        """[3,4,6,3] — reference ``resnet50()`` (``resnet50…py:375``)."""
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+    @classmethod
+    def resnet101(cls, **kw) -> "ResNetDWT":
+        """[3,4,23,3] — the VisDA-2017 variant (BASELINE.json configs[4])."""
+        return cls(stage_sizes=(3, 4, 23, 3), **kw)
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        if train:
+            if x.shape[0] != self.num_domains:
+                raise ValueError(
+                    f"train input must be [D={self.num_domains}, N, H, W, C]; "
+                    f"got {x.shape}"
+                )
+            x = merge_domains(x)
+        x = x.astype(self.dtype)
+
+        # Whitened stem: 7x7/2 conv → DWT → affine → relu → 3x3/2 maxpool
+        # (resnet50…py:271-291,332-338).
+        x = fnn.Conv(
+            64,
+            (7, 7),
+            strides=(2, 2),
+            padding=((3, 3), (3, 3)),
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=_conv_init,
+            name="conv1",
+        )(x)
+        x = apply_domain_norm(
+            x,
+            DomainWhiten(
+                64,
+                self.group_size,
+                num_domains=self.num_domains,
+                eval_domain=self.eval_domain,
+                momentum=self.momentum,
+                axis_name=self.axis_name,
+                name="dn1",
+            ),
+            train,
+            self.num_domains,
+        )
+        x = fnn.relu(x)
+        x = fnn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        for stage, num_blocks in enumerate(self.stage_sizes, start=1):
+            planes = 64 * 2 ** (stage - 1)
+            for block in range(num_blocks):
+                stride = 2 if (stage > 1 and block == 0) else 1
+                x = BottleneckDWT(
+                    planes=planes,
+                    stride=stride,
+                    # Stage 1 whitens; deeper stages batch-normalize
+                    # (resnet50…py:73-105 layer==1 dispatch).
+                    use_whitening=(stage == 1),
+                    has_downsample=(block == 0),
+                    group_size=self.group_size,
+                    num_domains=self.num_domains,
+                    eval_domain=self.eval_domain,
+                    momentum=self.momentum,
+                    axis_name=self.axis_name,
+                    dtype=self.dtype,
+                    name=f"layer{stage}_{block}",
+                )(x, train)
+
+        x = jnp.mean(x, axis=(-3, -2))  # global average pool → [B, C]
+        x = fnn.Dense(self.num_classes, dtype=self.dtype, name="fc_out")(x)
+
+        if train:
+            x = split_domains(x, self.num_domains)
+        return x

@@ -1,0 +1,34 @@
+"""dwt_tpu.parallel — device mesh + data-parallel step sharding.
+
+The reference is single-process, single-GPU (SURVEY §2: no torch.distributed
+anywhere); data parallelism is a *new* first-class subsystem here, built the
+TPU way: a 1-D ``jax.sharding.Mesh`` over the chips, ``shard_map`` of the
+whole train step with the per-domain batch axis sharded, XLA collectives
+over ICI doing what NCCL would do on GPU.
+
+The one place DP touches the model math: per-replica whitening/BN batch
+moments must be ``pmean``'d across the mesh axis so every replica computes
+the *global-batch* statistics the reference computes on its single device
+(``whitening.py:41,47`` equivalents) — the ops take ``axis_name`` for
+exactly this, and ``tests/test_parallel.py`` pins sharded-vs-global parity.
+"""
+
+from dwt_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+    initialize_distributed,
+)
+from dwt_tpu.parallel.dp import (
+    make_sharded_train_step,
+    shard_batch,
+    replicate_state,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "make_mesh",
+    "initialize_distributed",
+    "make_sharded_train_step",
+    "shard_batch",
+    "replicate_state",
+]

@@ -1,0 +1,50 @@
+"""Mesh construction and multi-host initialization."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical name of the data-parallel mesh axis; the same string must be the
+# ``axis_name`` the model's norm sites pmean over.
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """1-D data-parallel mesh over the given (default: all) devices.
+
+    On a pod slice, ``jax.devices()`` is already ordered so that neighboring
+    indices are ICI neighbors — a 1-D mesh keeps the gradient/moment
+    all-reduces on ICI.  Multi-slice (DCN) setups should reshape to a 2-D
+    ``("dcn", "data")`` mesh; that axis split is a caller decision.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize`` wrapper.
+
+    On Cloud TPU pods the arguments are auto-detected from the environment;
+    explicit values support bare-metal/DCN setups.  Safe to call once per
+    process before any device access.  (Reference has no analogue — it is
+    single-process; SURVEY §5 distributed-backend note.)
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

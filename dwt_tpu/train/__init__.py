@@ -1,0 +1,30 @@
+"""dwt_tpu.train — jitted train/eval steps, optimizers, schedules.
+
+TPU-first re-design of the reference's L4 training loops
+(``usps_mnist.py:281-327``, ``resnet50_dwt_mec_officehome.py:380-464``):
+the per-batch body collapses into one jitted, functionally-pure
+``train_step(state, batch) -> (state, metrics)`` (SURVEY §3.4), with running
+norm statistics carried in the train state rather than mutated module
+buffers.  Host code only feeds batches and logs metrics.
+"""
+
+from dwt_tpu.train.state import TrainState, create_train_state
+from dwt_tpu.train.optim import adam_l2, multistep_schedule, sgd_two_group
+from dwt_tpu.train.steps import (
+    make_digits_train_step,
+    make_eval_step,
+    make_officehome_train_step,
+    make_stat_collection_step,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "adam_l2",
+    "multistep_schedule",
+    "sgd_two_group",
+    "make_digits_train_step",
+    "make_eval_step",
+    "make_officehome_train_step",
+    "make_stat_collection_step",
+]

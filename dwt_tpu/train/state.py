@@ -1,0 +1,49 @@
+"""Functional train state: one pytree through jit/pjit/scan/checkpoint."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """Everything a train step threads: params, norm stats, optimizer state.
+
+    The reference keeps running stats as hidden module buffers mutated
+    in-place (``whitening.py:57-59``); here they are the ``batch_stats``
+    leaf of this dataclass, so checkpointing/sharding/scanning the whole
+    training process is ordinary pytree plumbing.
+    """
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: optax.OptState
+
+    def replace_stats(self, batch_stats: Any) -> "TrainState":
+        return self.replace(batch_stats=batch_stats)
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    sample_train_batch: jax.Array,
+    tx: optax.GradientTransformation,
+) -> TrainState:
+    """Initialize model variables on a sample training batch and wrap them.
+
+    ``sample_train_batch`` must have the training layout (leading domain
+    axis) so every domain norm site materializes its stat branches.
+    """
+    variables = model.init(rng, sample_train_batch, train=True)
+    params = variables["params"]
+    return TrainState(
+        step=jax.numpy.zeros((), jax.numpy.int32),
+        params=params,
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(params),
+    )

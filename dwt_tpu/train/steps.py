@@ -1,0 +1,182 @@
+"""Jitted train/eval step factories for the two reference experiments.
+
+Each factory closes over a model and optimizer and returns a pure function
+``(state, batch) -> (state, metrics)`` suitable for ``jax.jit`` (single
+device) or ``shard_map`` over a mesh (``dwt_tpu.parallel``).  Passing
+``axis_name`` makes the step all-reduce gradients and metrics across the
+mapped axis; the model's norm sites must be built with the same
+``axis_name`` so batch moments are pmean'd too (SURVEY §5 distributed note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from dwt_tpu.ops.losses import entropy_loss, mec_loss, nll_loss, softmax_cross_entropy
+from dwt_tpu.train.state import TrainState
+
+Batch = Dict[str, jax.Array]
+Metrics = Dict[str, jax.Array]
+
+
+def _apply_grads(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    grads: Any,
+    batch_stats: Any,
+) -> TrainState:
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return state.replace(
+        step=state.step + 1,
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+    )
+
+
+def _pmean_if(tree: Any, axis_name: Optional[str]) -> Any:
+    if axis_name is None:
+        return tree
+    return lax.pmean(tree, axis_name)
+
+
+def make_digits_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    lambda_entropy: float = 0.1,
+    axis_name: Optional[str] = None,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
+    """Digits (USPS↔MNIST) step: cls loss on source + λ·entropy on target.
+
+    Reference loop body at ``usps_mnist.py:281-308``: concat halves, one
+    forward, ``nll(log_softmax(src), y) + λ·H(tgt)``, Adam step.  Here the
+    two domains arrive stacked (``[2, N, 28, 28, 1]``).
+    """
+
+    def train_step(state: TrainState, batch: Batch):
+        x = jnp.stack([batch["source_x"], batch["target_x"]])
+
+        def loss_fn(params):
+            logits, updated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            cls = softmax_cross_entropy(logits[0], batch["source_y"])
+            ent = lambda_entropy * entropy_loss(logits[1])
+            return cls + ent, (updated["batch_stats"], cls, ent)
+
+        (loss, (stats, cls, ent)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = _pmean_if(grads, axis_name)
+        metrics = _pmean_if(
+            {"loss": loss, "cls_loss": cls, "entropy_loss": ent}, axis_name
+        )
+        return _apply_grads(state, tx, grads, stats), metrics
+
+    return train_step
+
+
+def make_officehome_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    lambda_mec: float = 0.1,
+    axis_name: Optional[str] = None,
+) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
+    """OfficeHome step: cls on source + λ·MEC between the two target views.
+
+    Reference loop body at ``resnet50_dwt_mec_officehome.py:400-431``:
+    concat thirds (source, target, augmented-target), one forward,
+    ``nll + λ·MEC(tgt, tgt_aug)``, SGD step.  Domains arrive stacked
+    (``[3, N, H, W, C]``).
+    """
+
+    def train_step(state: TrainState, batch: Batch):
+        x = jnp.stack(
+            [batch["source_x"], batch["target_x"], batch["target_aug_x"]]
+        )
+
+        def loss_fn(params):
+            logits, updated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            cls = softmax_cross_entropy(logits[0], batch["source_y"])
+            mec = lambda_mec * mec_loss(logits[1], logits[2])
+            return cls + mec, (updated["batch_stats"], cls, mec)
+
+        (loss, (stats, cls, mec)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = _pmean_if(grads, axis_name)
+        metrics = _pmean_if(
+            {"loss": loss, "cls_loss": cls, "mec_loss": mec}, axis_name
+        )
+        return _apply_grads(state, tx, grads, stats), metrics
+
+    return train_step
+
+
+def make_eval_step(
+    model, axis_name: Optional[str] = None
+) -> Callable[[Any, Any, jax.Array, jax.Array], Metrics]:
+    """Eval step accumulators matching the reference ``test()`` functions.
+
+    Returns summed nll loss, correct-prediction count, and sample count per
+    call (``usps_mnist.py:310-327``, ``resnet50…py:447-464`` accumulate sum
+    loss / correct over the whole test set and normalize at the end); with
+    ``axis_name`` the counters are psum'd across replicas.
+    """
+
+    def eval_step(params, batch_stats, x: jax.Array, y: jax.Array) -> Metrics:
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss_sum = nll_loss(logp, y, reduction="sum")
+        correct = jnp.sum(
+            (jnp.argmax(logits, axis=-1) == y).astype(jnp.int32)
+        )
+        count = jnp.asarray(y.shape[0], jnp.int32)
+        out = {"loss_sum": loss_sum, "correct": correct, "count": count}
+        if axis_name is not None:
+            out = lax.psum(out, axis_name)
+        return out
+
+    return eval_step
+
+
+def make_stat_collection_step(
+    model, num_domains: int
+) -> Callable[[TrainState, jax.Array], TrainState]:
+    """The post-training stat-collection pass (gradient-free train forward).
+
+    Reference ``eval_pass_collect_stats`` (``resnet50…py:380-389``): after
+    training, run 10 full passes over the target *test* set with the model
+    in train mode under no_grad, feeding ``cat(data, data, data)`` — the
+    same batch tiled into every domain slot — purely to advance the running
+    stats toward the target distribution ("dont care about source statistics
+    after its trained", ``:387``).  Only ``batch_stats`` changes.
+    """
+
+    def collect(state: TrainState, x: jax.Array) -> TrainState:
+        tiled = jnp.broadcast_to(x[None], (num_domains,) + x.shape)
+        _, updated = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            tiled,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return state.replace_stats(updated["batch_stats"])
+
+    return collect

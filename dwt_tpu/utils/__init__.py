@@ -1,0 +1,10 @@
+"""dwt_tpu.utils — metrics logging and checkpoint helpers."""
+
+from dwt_tpu.utils.metrics import MetricLogger
+from dwt_tpu.utils.checkpoint import (
+    latest_step,
+    restore_state,
+    save_state,
+)
+
+__all__ = ["MetricLogger", "latest_step", "restore_state", "save_state"]

@@ -1,0 +1,47 @@
+"""Orbax checkpoint save/restore for ``TrainState`` (SURVEY §5).
+
+The reference never saves anything (checkpoint/resume is read-only there,
+``resnet50…py:367``); preemption resilience on TPU requires periodic saves.
+The whole ``TrainState`` is one pytree, so Orbax handles it directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _root(ckpt_dir: str) -> str:
+    return os.path.abspath(os.path.expanduser(ckpt_dir))
+
+
+def save_state(ckpt_dir: str, step: int, state: Any) -> str:
+    """Write ``state`` under ``ckpt_dir/<step>``; returns the path."""
+    path = os.path.join(_root(ckpt_dir), str(int(step)))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    root = _root(ckpt_dir)
+    if not os.path.isdir(root):
+        return None
+    steps = [int(d) for d in os.listdir(root) if d.isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_state(ckpt_dir: str, template: Any, step: Optional[int] = None) -> Any:
+    """Restore the checkpoint at ``step`` (default: latest) shaped like
+    ``template`` (a concrete or abstract ``TrainState``)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(_root(ckpt_dir), str(int(step)))
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract)

@@ -1,0 +1,42 @@
+"""Structured metric logging (SURVEY §5: replaces the reference's prints).
+
+Emits both a human-readable line (same quantities the reference prints —
+cls/entropy/MEC losses and test accuracy, ``usps_mnist.py:305-308,323-325``)
+and a machine-parseable JSON record, to stdout and optionally a JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+
+class MetricLogger:
+    def __init__(self, jsonl_path: Optional[str] = None, stream: IO = sys.stdout):
+        self.stream = stream
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+        self._t0 = time.time()
+
+    def log(self, kind: str, step: int, **values: float) -> None:
+        record = {
+            "kind": kind,
+            "step": int(step),
+            "elapsed_s": round(time.time() - self._t0, 3),
+            **{k: (float(v) if hasattr(v, "__float__") else v)
+               for k, v in values.items()},
+        }
+        pretty = " ".join(
+            f"{k}={v:.6f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in record.items()
+            if k not in ("kind",)
+        )
+        print(f"[{kind}] {pretty}", file=self.stream, flush=True)
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

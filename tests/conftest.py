@@ -1,11 +1,14 @@
 """Test harness config: run everything on a fake 8-device CPU mesh.
 
-Must set XLA flags before jax initializes (SURVEY §4.4).
+Must set XLA flags before jax initializes (SURVEY §4.4).  The environment
+pins ``JAX_PLATFORMS=axon`` (the real-TPU relay) globally, so this FORCES
+cpu — tests are CI, not TPU verification, and must never claim the relay
+(a killed test client can wedge the single-chip claim for later clients).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,148 @@
+"""Checkpoint-converter tests on a synthetic state_dict with the REAL key
+scheme (``resnet50_dwt_mec_officehome.py:76-105,184-213,365-378``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.convert import (
+    convert_resnet_state_dict,
+    load_pytorch_checkpoint,
+)
+from dwt_tpu.nn import ResNetDWT
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = ResNetDWT(stage_sizes=(1, 1, 1, 1), num_classes=7, group_size=4)
+    x = jnp.zeros((3, 2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=True)
+    return model, variables
+
+
+def _synthetic_state_dict(rng):
+    """Keys exactly as the reference checkpoint spells them."""
+    sd = {
+        "conv1.weight": rng.normal(size=(64, 3, 7, 7)),
+        "bn1.wh.running_mean": rng.normal(size=(1, 64, 1, 1)),
+        "bn1.wh.running_variance": rng.normal(size=(16, 4, 4)),
+        "bn1.gamma": rng.normal(size=(64, 1, 1)),
+        "bn1.beta": rng.normal(size=(64, 1, 1)),
+        # layer1 block 0: whitening sites + downsample
+        "layer1.0.conv1.weight": rng.normal(size=(64, 64, 1, 1)),
+        "layer1.0.conv2.weight": rng.normal(size=(64, 64, 3, 3)),
+        "layer1.0.conv3.weight": rng.normal(size=(256, 64, 1, 1)),
+        "layer1.0.downsample.0.weight": rng.normal(size=(256, 64, 1, 1)),
+        "layer1.0.downsample_bn.wh.running_mean": rng.normal(size=(1, 256, 1, 1)),
+        "layer1.0.downsample_bn.wh.running_variance": rng.normal(size=(64, 4, 4)),
+        "layer1.0.downsample_bn.gamma": rng.normal(size=(256, 1, 1)),
+        "layer1.0.downsample_bn.beta": rng.normal(size=(256, 1, 1)),
+        # layer2 block 0: BN sites
+        "layer2.0.bn1.running_mean": rng.normal(size=(128,)),
+        "layer2.0.bn1.running_var": rng.normal(size=(128,)) ** 2 + 1.0,
+        "layer2.0.bn1.weight": rng.normal(size=(128,)),
+        "layer2.0.bn1.bias": rng.normal(size=(128,)),
+        "layer2.0.bn1.num_batches_tracked": np.asarray(7),
+        # head from ImageNet: 1000 classes — must be shape-skipped
+        "fc.weight": rng.normal(size=(1000, 2048)),
+        "fc.bias": rng.normal(size=(1000,)),
+        # something with no destination at all
+        "some.novel.buffer": rng.normal(size=(3,)),
+    }
+    for k in range(1, 4):
+        c = 64 if k < 3 else 256
+        sd[f"layer1.0.bn{k}.wh.running_mean"] = rng.normal(size=(1, c, 1, 1))
+        sd[f"layer1.0.bn{k}.wh.running_variance"] = rng.normal(size=(c // 4, 4, 4))
+        sd[f"layer1.0.bn{k}.gamma"] = rng.normal(size=(c, 1, 1))
+        sd[f"layer1.0.bn{k}.beta"] = rng.normal(size=(c, 1, 1))
+    return {k: np.asarray(v, np.float32) for k, v in sd.items()}
+
+
+def test_convert_places_and_transforms(tiny):
+    model, variables = tiny
+    sd = _synthetic_state_dict(np.random.default_rng(0))
+    new_vars, report = convert_resnet_state_dict(sd, variables, num_domains=3)
+
+    # conv: OIHW -> HWIO
+    np.testing.assert_allclose(
+        np.asarray(new_vars["params"]["conv1"]["kernel"]),
+        np.transpose(sd["conv1.weight"], (2, 3, 1, 0)),
+    )
+    # stem whitening mean: [1,C,1,1] -> tiled [3, C] across domain branches
+    wh = new_vars["batch_stats"]["dn1"]["whitening"]
+    for d in range(3):
+        np.testing.assert_allclose(
+            np.asarray(wh.mean[d]), sd["bn1.wh.running_mean"].reshape(-1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(wh.cov[d]), sd["bn1.wh.running_variance"]
+        )
+    # affine: [C,1,1] -> [C] param
+    np.testing.assert_allclose(
+        np.asarray(new_vars["params"]["dn1"]["gamma"]),
+        sd["bn1.gamma"].reshape(-1),
+    )
+    # BN site: running stats + weight/bias -> gamma/beta + count
+    bn = new_vars["batch_stats"]["layer2_0"]["dn1"]["bn"]
+    np.testing.assert_allclose(
+        np.asarray(bn.mean[2]), sd["layer2.0.bn1.running_mean"]
+    )
+    np.testing.assert_allclose(np.asarray(bn.count), [7, 7, 7])
+    np.testing.assert_allclose(
+        np.asarray(new_vars["params"]["layer2_0"]["dn1"]["gamma"]),
+        sd["layer2.0.bn1.weight"],
+    )
+    # downsample conv + norm
+    np.testing.assert_allclose(
+        np.asarray(new_vars["params"]["layer1_0"]["downsample_conv"]["kernel"]),
+        np.transpose(sd["layer1.0.downsample.0.weight"], (2, 3, 1, 0)),
+    )
+
+    # strict=False bookkeeping
+    assert "some.novel.buffer" in report.skipped_unexpected
+    mismatched = [k for k, _, _ in report.skipped_shape_mismatch]
+    assert "fc.weight" in mismatched and "fc.bias" in mismatched
+    assert "conv1.weight" in report.loaded
+
+    # Untouched leaves keep their fresh init (e.g. layer3 conv).
+    np.testing.assert_array_equal(
+        np.asarray(new_vars["params"]["layer3_0"]["conv1"]["kernel"]),
+        np.asarray(variables["params"]["layer3_0"]["conv1"]["kernel"]),
+    )
+    # Input variables not mutated.
+    np.testing.assert_allclose(np.asarray(variables["batch_stats"]["dn1"]
+                                          ["whitening"].mean), 0.0)
+
+
+def test_converted_model_eval_runs(tiny):
+    model, variables = tiny
+    sd = _synthetic_state_dict(np.random.default_rng(1))
+    # Make the injected whitening covariances PSD so Cholesky is finite.
+    for k in list(sd):
+        if k.endswith("wh.running_variance"):
+            a = sd[k]
+            sd[k] = (a @ a.transpose(0, 2, 1) / a.shape[-1]).astype(np.float32)
+    new_vars, _ = convert_resnet_state_dict(sd, variables, num_domains=3)
+    out = model.apply(new_vars, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 7)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_load_pytorch_checkpoint_strips_module_prefix(tmp_path, tiny):
+    torch = pytest.importorskip("torch")
+    model, variables = tiny
+    sd = _synthetic_state_dict(np.random.default_rng(2))
+    archive = {
+        "state_dict": {
+            "module." + k: torch.from_numpy(v) for k, v in sd.items()
+        }
+    }
+    path = tmp_path / "model_best_gr_4.pth.tar"
+    torch.save(archive, path)
+
+    loaded = load_pytorch_checkpoint(str(path))
+    assert set(loaded) == set(sd)
+    np.testing.assert_allclose(loaded["conv1.weight"], sd["conv1.weight"])
+    new_vars, report = convert_resnet_state_dict(loaded, variables)
+    assert "conv1.weight" in report.loaded

@@ -1,0 +1,84 @@
+"""End-to-end smoke tests: CLIs on synthetic data + Orbax checkpointing."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.train import adam_l2, create_train_state
+from dwt_tpu.utils import latest_step, restore_state, save_state
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = LeNetDWT(group_size=4)
+    tx = adam_l2(1e-3)
+    sample = jnp.zeros((2, 4, 28, 28, 1), jnp.float32)
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    state = state.replace(step=state.step + 7)
+
+    save_state(str(tmp_path / "ck"), 7, state)
+    assert latest_step(str(tmp_path / "ck")) == 7
+    restored = restore_state(str(tmp_path / "ck"), state)
+    assert int(restored.step) == 7
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_digits_cli_synthetic_with_resume(tmp_path):
+    from dwt_tpu.cli.usps_mnist import main
+
+    ckpt = str(tmp_path / "digits_ck")
+    args = [
+        "--synthetic",
+        "--synthetic_size", "32",
+        "--source_batch_size", "8",
+        "--target_batch_size", "8",
+        "--test_batch_size", "16",
+        "--group_size", "4",
+        "--epochs", "2",
+        "--log_interval", "2",
+        "--ckpt_dir", ckpt,
+        "--ckpt_every_epochs", "1",
+        "--metrics_jsonl", str(tmp_path / "metrics.jsonl"),
+    ]
+    acc = main(args)
+    assert 0.0 <= acc <= 100.0
+    saved = latest_step(ckpt)
+    assert saved == 2 * (32 // 8)  # epochs * steps_per_epoch
+    assert os.path.getsize(tmp_path / "metrics.jsonl") > 0
+
+    # Resume: asking for 3 epochs continues from the saved 2.
+    acc2 = main(args[:-6] + ["--epochs", "3", "--ckpt_dir", ckpt,
+                             "--ckpt_every_epochs", "1"])
+    assert latest_step(ckpt) == 3 * (32 // 8)
+    assert 0.0 <= acc2 <= 100.0
+
+
+@pytest.mark.slow
+def test_officehome_cli_synthetic(tmp_path):
+    from dwt_tpu.cli.officehome import main
+
+    acc = main(
+        [
+            "--synthetic",
+            "--synthetic_size", "12",
+            "--arch", "tiny",
+            "--img_crop_size", "32",
+            "--num_classes", "5",
+            "--source_batch_size", "6",
+            "--test_batch_size", "6",
+            "--num_iters", "3",
+            "--check_acc_step", "2",
+            "--stat_collection_passes", "1",
+            "--log_interval", "1",
+            "--group_size", "4",
+            "--metrics_jsonl", str(tmp_path / "oh.jsonl"),
+        ]
+    )
+    assert 0.0 <= acc <= 100.0
+    lines = open(tmp_path / "oh.jsonl").read().strip().splitlines()
+    kinds = {__import__("json").loads(l)["kind"] for l in lines}
+    assert {"train", "test", "stat_collection", "final_test"} <= kinds

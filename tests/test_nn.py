@@ -1,0 +1,149 @@
+"""Module-layer tests: domain norms and LeNetDWT routing semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.nn import DomainBatchNorm, DomainWhiten, LeNetDWT
+from dwt_tpu.ops import batch_norm, group_whiten
+
+
+def test_domain_whiten_matches_per_branch_op():
+    """Branch d of the module must reproduce group_whiten on slice d."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 6, 3, 3, 8)), jnp.float32)
+    mod = DomainWhiten(features=8, group_size=4, num_domains=2, use_affine=False)
+    variables = mod.init(jax.random.key(0), x, train=True)
+    y, updated = mod.apply(variables, x, train=True, mutable=["batch_stats"])
+
+    stats0 = jax.tree.map(
+        lambda a: a[0], variables["batch_stats"]["whitening"]
+    )
+    y0, new0 = group_whiten(x[0], stats0, group_size=4, train=True)
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(y0), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(updated["batch_stats"]["whitening"].mean[0]),
+        np.asarray(new0.mean),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_domain_whiten_eval_uses_eval_domain_branch_only():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(5, 3, 3, 8)), jnp.float32)
+    mod = DomainWhiten(features=8, group_size=4, num_domains=2, eval_domain=1,
+                       use_affine=False)
+    variables = mod.init(jax.random.key(0), x[None].repeat(2, 0), train=True)
+    # Give the two branches very different stats.
+    stats = variables["batch_stats"]["whitening"]
+    stats = stats._replace(
+        mean=stats.mean.at[0].set(100.0),
+        cov=stats.cov.at[0].mul(50.0),
+    )
+    variables = {"batch_stats": {"whitening": stats}}
+    y = mod.apply(variables, x, train=False)
+    branch1 = jax.tree.map(lambda a: a[1], stats)
+    y1, _ = group_whiten(x, branch1, group_size=4, train=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y1), rtol=1e-5, atol=1e-5)
+    # And it must NOT equal branch 0's result.
+    branch0 = jax.tree.map(lambda a: a[0], stats)
+    y0, _ = group_whiten(x, branch0, group_size=4, train=False)
+    assert not np.allclose(np.asarray(y), np.asarray(y0))
+
+
+def test_domain_batch_norm_matches_per_branch_op():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(3, 8, 16)), jnp.float32)  # [D, N, C]
+    mod = DomainBatchNorm(features=16, num_domains=3, use_affine=False)
+    variables = mod.init(jax.random.key(0), x, train=True)
+    y, updated = mod.apply(variables, x, train=True, mutable=["batch_stats"])
+    for d in range(3):
+        sd = jax.tree.map(lambda a: a[d], variables["batch_stats"]["bn"])
+        yd, nd = batch_norm(x[d], sd, train=True)
+        np.testing.assert_allclose(np.asarray(y[d]), np.asarray(yd), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(updated["batch_stats"]["bn"].var[d]),
+            np.asarray(nd.var), rtol=1e-5, atol=1e-6)
+
+
+def test_domain_norm_rejects_missing_domain_axis():
+    x = jnp.zeros((4, 16))
+    mod = DomainBatchNorm(features=16, num_domains=2)
+    with pytest.raises(ValueError, match="domain axis"):
+        mod.init(jax.random.key(0), x, train=True)
+
+
+def test_lenet_shapes_and_eval_routing():
+    model = LeNetDWT(group_size=4)
+    x_train = jnp.asarray(
+        np.random.default_rng(3).normal(size=(2, 4, 28, 28, 1)), jnp.float32
+    )
+    variables = model.init(jax.random.key(0), x_train, train=True)
+    logits, updated = model.apply(
+        variables, x_train, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (2, 4, 10)
+
+    # Eval: no domain axis, runs on running stats, no state change needed.
+    x_eval = x_train[1]
+    logits_eval = model.apply(
+        {"params": variables["params"], **updated}, x_eval, train=False
+    )
+    assert logits_eval.shape == (4, 10)
+    assert np.all(np.isfinite(np.asarray(logits_eval)))
+
+
+def test_lenet_eval_depends_only_on_target_branch_stats():
+    """Perturbing SOURCE branch stats must not change eval output."""
+    model = LeNetDWT(group_size=4)
+    x_train = jnp.asarray(
+        np.random.default_rng(4).normal(size=(2, 4, 28, 28, 1)), jnp.float32
+    )
+    variables = model.init(jax.random.key(0), x_train, train=True)
+    _, updated = model.apply(
+        variables, x_train, train=True, mutable=["batch_stats"]
+    )
+    params = variables["params"]
+    stats = updated["batch_stats"]
+
+    x_eval = x_train[0]
+    base = model.apply({"params": params, "batch_stats": stats}, x_eval, train=False)
+
+    # Perturb every branch-0 (source) stat leaf; eval must be invariant.
+    poison = lambda a: a.at[0].add(jnp.asarray(7, a.dtype))
+    poisoned = jax.tree.map(poison, stats)
+    same = model.apply(
+        {"params": params, "batch_stats": poisoned}, x_eval, train=False
+    )
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(same))
+
+    # Perturbing branch-1 (target) stats MUST change eval output.
+    poisoned_t = jax.tree.map(lambda a: a.at[1].add(jnp.asarray(7, a.dtype)), stats)
+    diff = model.apply(
+        {"params": params, "batch_stats": poisoned_t}, x_eval, train=False
+    )
+    assert not np.allclose(np.asarray(base), np.asarray(diff))
+
+
+def test_lenet_train_step_updates_all_branch_stats():
+    model = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(5)
+    # Source and target drawn from different distributions.
+    x = jnp.asarray(
+        np.stack([rng.normal(size=(4, 28, 28, 1)),
+                  rng.normal(loc=2.0, size=(4, 28, 28, 1))]),
+        jnp.float32,
+    )
+    variables = model.init(jax.random.key(0), x, train=True)
+    _, updated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    before = variables["batch_stats"]
+    after = updated["batch_stats"]
+    changed = jax.tree.map(
+        lambda a, b: np.any(np.asarray(a) != np.asarray(b)), before, after
+    )
+    assert all(jax.tree.leaves(changed))

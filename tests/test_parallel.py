@@ -1,0 +1,94 @@
+"""SURVEY §4.4 distributed tests: sharded-vs-global parity on the fake mesh.
+
+The invariant: a shard_map'd train step over 8 devices, with batch moments
+and gradients pmean'd, must reproduce the single-device global-batch step
+bit-for-bit (up to summation-order float noise) — exactly the semantics of
+the reference's one-GPU global-batch moments (``whitening.py:41,47``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.parallel import (
+    DATA_AXIS,
+    make_mesh,
+    make_sharded_train_step,
+    replicate_state,
+    shard_batch,
+)
+from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "source_x": jnp.asarray(
+            rng.normal(size=(n, 28, 28, 1)), jnp.float32
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(n,))),
+        "target_x": jnp.asarray(
+            rng.normal(loc=0.5, size=(n, 28, 28, 1)), jnp.float32
+        ),
+    }
+
+
+@pytest.mark.slow
+def test_sharded_train_step_matches_global_batch():
+    assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
+    mesh = make_mesh(jax.devices()[:8])
+    batch = _batch(8)
+
+    tx = adam_l2(1e-3, 5e-4)
+    # Init once (axis-free — init must not trace collectives outside the
+    # mesh context); both steps start from identical state.
+    model_global = LeNetDWT(group_size=4)
+    model_dp = LeNetDWT(group_size=4, axis_name=DATA_AXIS)
+    sample = jnp.stack([batch["source_x"], batch["target_x"]])
+    state = create_train_state(model_global, jax.random.key(0), sample, tx)
+
+    global_step = jax.jit(make_digits_train_step(model_global, tx, 0.1))
+    dp_step = make_sharded_train_step(
+        make_digits_train_step(model_dp, tx, 0.1, axis_name=DATA_AXIS), mesh
+    )
+
+    state_g, metrics_g = global_step(state, batch)
+    state_s, metrics_s = dp_step(
+        replicate_state(state, mesh), shard_batch(batch, mesh)
+    )
+    # Second step so EMA'd stats feed back into the forward once.
+    state_g, metrics_g = global_step(state_g, batch)
+    state_s, metrics_s = dp_step(state_s, shard_batch(batch, mesh))
+
+    for k in metrics_g:
+        np.testing.assert_allclose(
+            float(metrics_s[k]), float(metrics_g[k]), rtol=1e-5, atol=1e-6
+        )
+    flat_g = jax.tree.leaves(state_g.params)
+    flat_s = jax.tree.leaves(state_s.params)
+    for a, b in zip(flat_s, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    for a, b in zip(
+        jax.tree.leaves(state_s.batch_stats), jax.tree.leaves(state_g.batch_stats)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_shard_batch_places_leading_axis_across_mesh():
+    mesh = make_mesh(jax.devices()[:8])
+    batch = _batch(8)
+    sharded = shard_batch(batch, mesh)
+    x = sharded["source_x"]
+    assert len(x.sharding.device_set) == 8
+    # Each device holds one sample.
+    shard = x.addressable_shards[0]
+    assert shard.data.shape == (1, 28, 28, 1)
+
+    replicated = replicate_state({"w": jnp.ones((4, 4))}, mesh)
+    assert replicated["w"].addressable_shards[0].data.shape == (4, 4)

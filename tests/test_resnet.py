@@ -1,0 +1,115 @@
+"""ResNet-DWT structure and routing tests (kept tiny: fake stage sizes).
+
+Full-size ResNet-50 compiles are too heavy for the 1-core CI box; the
+architecture is exercised with a [1,1,1,1] stage list — same stem, same
+block wiring, same whitening/BN dispatch, same triple-branch routing — and
+the 50/101 constructors are checked structurally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.nn import ResNetDWT
+
+
+def tiny_resnet(**kw):
+    return ResNetDWT(stage_sizes=(1, 1, 1, 1), num_classes=7, group_size=4, **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    model = tiny_resnet()
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(3, 2, 64, 64, 3)), jnp.float32
+    )
+    variables = model.init(jax.random.key(0), x, train=True)
+    return model, x, variables
+
+
+def test_constructors_stage_sizes():
+    assert ResNetDWT.resnet50().stage_sizes == (3, 4, 6, 3)
+    assert ResNetDWT.resnet101().stage_sizes == (3, 4, 23, 3)
+
+
+def test_whitening_in_stem_and_stage1_bn_elsewhere(tiny_setup):
+    _, _, variables = tiny_setup
+    stats = variables["batch_stats"]
+    # Stem + layer1 norm sites are whitening; layers 2-4 are BN.
+    assert "whitening" in stats["dn1"]
+    assert "whitening" in stats["layer1_0"]["dn1"]
+    assert "whitening" in stats["layer1_0"]["downsample_dn"]
+    for stage in (2, 3, 4):
+        assert "bn" in stats[f"layer{stage}_0"]["dn2"]
+        assert "bn" in stats[f"layer{stage}_0"]["downsample_dn"]
+    # Triple branches everywhere: leading domain axis of 3.
+    assert stats["dn1"]["whitening"].mean.shape == (3, 64)
+    assert stats["layer2_0"]["dn1"]["bn"].mean.shape == (3, 128)
+
+
+def test_train_forward_shapes_and_stat_updates(tiny_setup):
+    model, x, variables = tiny_setup
+    logits, updated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (3, 2, 7)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    changed = [
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(variables["batch_stats"]),
+            jax.tree.leaves(updated["batch_stats"]),
+        )
+    ]
+    assert all(changed)
+
+
+def test_eval_routes_through_target_branch_only(tiny_setup):
+    model, x, variables = tiny_setup
+    _, updated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    params = variables["params"]
+    stats = updated["batch_stats"]
+    x_eval = x[1]
+
+    base = model.apply({"params": params, "batch_stats": stats}, x_eval,
+                       train=False)
+    assert base.shape == (2, 7)
+
+    # Source (0) and aug (2) branch stats must be dead in eval...
+    for dead in (0, 2):
+        poisoned = jax.tree.map(
+            lambda a: a.at[dead].add(jnp.asarray(3, a.dtype)), stats
+        )
+        out = model.apply(
+            {"params": params, "batch_stats": poisoned}, x_eval, train=False
+        )
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(out))
+    # ...and the target (1) branch must be live.
+    poisoned_t = jax.tree.map(
+        lambda a: a.at[1].add(jnp.asarray(3, a.dtype)), stats
+    )
+    out_t = model.apply(
+        {"params": params, "batch_stats": poisoned_t}, x_eval, train=False
+    )
+    assert not np.allclose(np.asarray(base), np.asarray(out_t))
+
+
+def test_bf16_forward_keeps_f32_stats(tiny_setup):
+    _, x, _ = tiny_setup
+    model16 = tiny_resnet(dtype=jnp.bfloat16)
+    x16 = x.astype(jnp.bfloat16)
+    variables = model16.init(jax.random.key(1), x16, train=True)
+    logits, updated = model16.apply(
+        variables, x16, train=True, mutable=["batch_stats"]
+    )
+    assert logits.dtype == jnp.bfloat16
+    assert updated["batch_stats"]["dn1"]["whitening"].mean.dtype == jnp.float32
+    assert updated["batch_stats"]["layer2_0"]["dn1"]["bn"].var.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(logits, dtype=np.float32)))
+
+
+def test_train_rejects_wrong_domain_count(tiny_setup):
+    model, x, variables = tiny_setup
+    with pytest.raises(ValueError, match="domain"):
+        model.apply(variables, x[:2], train=True, mutable=["batch_stats"])
