@@ -54,7 +54,7 @@ class LeNetDWT(fnn.Module):
         if train:
             if x.shape[0] != self.num_domains:
                 raise ValueError(
-                    f"train input must be [D={self.num_domains}, N, 28, 28, 1]; "
+                    f"train input must be [domains={self.num_domains}, N, 28, 28, 1]; "
                     f"got {x.shape}"
                 )
             batch_shape = x.shape[:2]

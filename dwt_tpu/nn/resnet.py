@@ -138,7 +138,7 @@ class ResNetDWT(fnn.Module):
         if train:
             if x.shape[0] != self.num_domains:
                 raise ValueError(
-                    f"train input must be [D={self.num_domains}, N, H, W, C]; "
+                    f"train input must be [domains={self.num_domains}, N, H, W, C]; "
                     f"got {x.shape}"
                 )
             x = merge_domains(x)

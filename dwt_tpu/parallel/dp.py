@@ -8,7 +8,10 @@ replicated, and three in-step collectives make per-replica execution exactly
 reproduce the reference's single-device global-batch numerics:
 
 * ``pmean`` of norm-site batch moments (inside the ops),
-* ``pmean`` of gradients (inside the step),
+* gradient averaging (inside the step): under varying-axis tracking the
+  backward pass auto-psums cotangents of the replicated params, so the step
+  divides by the axis size rather than calling ``pmean`` — see
+  ``dwt_tpu.train.steps._mean_grads_if``,
 * ``psum`` of eval counters (inside the eval step).
 
 Everything rides XLA collectives over ICI — there is no host-side
@@ -39,9 +42,9 @@ def make_sharded_train_step(
 ) -> Callable:
     """shard_map a ``(state, batch) -> (state, metrics)`` step over ``mesh``.
 
-    ``step_fn`` must already carry ``axis_name`` internally (grad pmean, op
-    moment pmean) — build it with the same ``axis_name`` given here.  State
-    is replicated; every batch leaf is sharded along its leading axis.
+    ``step_fn`` must already carry ``axis_name`` internally (grad averaging,
+    op moment pmean) — build it with the same ``axis_name`` given here.
+    State is replicated; every batch leaf is sharded along its leading axis.
     """
     mapped = _shard_map(
         step_fn,

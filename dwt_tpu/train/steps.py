@@ -46,6 +46,26 @@ def _pmean_if(tree: Any, axis_name: Optional[str]) -> Any:
     return lax.pmean(tree, axis_name)
 
 
+def _mean_grads_if(grads: Any, axis_name: Optional[str]) -> Any:
+    """Turn per-replica gradients of a *local-mean* loss into the gradient
+    of the global-mean loss.
+
+    Under shard_map with varying-axis tracking (jax >= 0.9), differentiating
+    wrt a REPLICATED param tree already inserts the cross-replica psum in
+    the transpose (the cotangent of an unvarying input must be unvarying),
+    so ``grads`` here is ``Σ_replicas ∂loss_r/∂θ`` — an explicit ``pmean``
+    would be an identity on the already-reduced value and leave gradients
+    at ``axis_size ×`` the global-batch gradient.  Dividing by the axis
+    size yields exactly ``∂((1/R)Σ_r loss_r)/∂θ``, the single-device
+    global-batch gradient (SURVEY §4.4 invariant) — verified to float
+    tolerance by ``tests/test_parallel.py``.
+    """
+    if axis_name is None:
+        return grads
+    size = lax.axis_size(axis_name)
+    return jax.tree.map(lambda g: g / size, grads)
+
+
 def make_digits_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -76,7 +96,7 @@ def make_digits_train_step(
         (loss, (stats, cls, ent)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        grads = _pmean_if(grads, axis_name)
+        grads = _mean_grads_if(grads, axis_name)
         metrics = _pmean_if(
             {"loss": loss, "cls_loss": cls, "entropy_loss": ent}, axis_name
         )
@@ -118,7 +138,7 @@ def make_officehome_train_step(
         (loss, (stats, cls, mec)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        grads = _pmean_if(grads, axis_name)
+        grads = _mean_grads_if(grads, axis_name)
         metrics = _pmean_if(
             {"loss": loss, "cls_loss": cls, "mec_loss": mec}, axis_name
         )

@@ -1,13 +1,19 @@
 """Test harness config: run everything on a fake 8-device CPU mesh.
 
-Must set XLA flags before jax initializes (SURVEY §4.4).  The environment
-pins ``JAX_PLATFORMS=axon`` (the real-TPU relay) globally, so this FORCES
-cpu — tests are CI, not TPU verification, and must never claim the relay
-(a killed test client can wedge the single-chip claim for later clients).
+Must set XLA flags before jax initializes backends (SURVEY §4.4).  The
+environment pins the real-TPU relay ("axon") globally, and its startup hook
+calls ``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter
+start — which *overrides* the ``JAX_PLATFORMS`` env var, so setting the env
+var alone no longer forces CPU.  Tests are CI, not TPU verification, and
+must never claim the relay (a killed test client can wedge the single-chip
+claim for later clients), so this forces CPU at the config level too.
 """
 
 import os
 
+# For any subprocesses tests spawn: strip the relay pool var (its presence
+# re-arms the startup hook) and pin CPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,4 +23,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The config-level override wins over the relay hook's "axon,cpu" selection
+# (config beats env; backends are not initialized yet at conftest time).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (multi-second compiles)"
+    )

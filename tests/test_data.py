@@ -156,14 +156,25 @@ def test_batch_iterator_drop_last_shuffle_shard():
     )
     assert not np.array_equal(batches[0][1], other[0][1])
 
-    # Sharding partitions the epoch across processes.
+    # Sharding partitions the epoch across processes.  drop_last=False for
+    # the coverage check: with the training default (drop_last=True) each
+    # shard drops its 5th sample (5 % 2 == 1), which is correct for the
+    # halves/thirds split but not full coverage — eval-style iteration must
+    # pass drop_last=False.
     seen = []
     for index in range(2):
         for _, y in batch_iterator(
-            ds, 2, shuffle=False, shard=(index, 2)
+            ds, 2, shuffle=False, drop_last=False, shard=(index, 2)
         ):
             seen.extend(y.tolist())
     assert sorted(seen) == list(range(10))
+    # The training default drops the ragged tail per shard.
+    dropped = [
+        y
+        for index in range(2)
+        for _, y in batch_iterator(ds, 2, shuffle=False, shard=(index, 2))
+    ]
+    assert sum(len(y) for y in dropped) == 8
 
 
 def test_infinite_restarts_epochs():

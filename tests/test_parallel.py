@@ -9,6 +9,7 @@ the reference's one-GPU global-batch moments (``whitening.py:41,47``).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from dwt_tpu.nn import LeNetDWT
@@ -35,15 +36,17 @@ def _batch(n=8, seed=0):
     }
 
 
-@pytest.mark.slow
-def test_sharded_train_step_matches_global_batch():
+def _run_parity(tx, steps=2):
+    """Run the same batch through the global step and the 8-way DP step.
+
+    Returns ``(state_g, metrics_g, state_s, metrics_s)``.  Init is axis-free
+    (init must not trace collectives outside the mesh context); both steps
+    start from identical state.
+    """
     assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
     mesh = make_mesh(jax.devices()[:8])
     batch = _batch(8)
 
-    tx = adam_l2(1e-3, 5e-4)
-    # Init once (axis-free — init must not trace collectives outside the
-    # mesh context); both steps start from identical state.
     model_global = LeNetDWT(group_size=4)
     model_dp = LeNetDWT(group_size=4, axis_name=DATA_AXIS)
     sample = jnp.stack([batch["source_x"], batch["target_x"]])
@@ -54,30 +57,74 @@ def test_sharded_train_step_matches_global_batch():
         make_digits_train_step(model_dp, tx, 0.1, axis_name=DATA_AXIS), mesh
     )
 
-    state_g, metrics_g = global_step(state, batch)
-    state_s, metrics_s = dp_step(
-        replicate_state(state, mesh), shard_batch(batch, mesh)
-    )
-    # Second step so EMA'd stats feed back into the forward once.
-    state_g, metrics_g = global_step(state_g, batch)
-    state_s, metrics_s = dp_step(state_s, shard_batch(batch, mesh))
+    state_g, metrics_g = state, None
+    state_s, metrics_s = replicate_state(state, mesh), None
+    sharded = shard_batch(batch, mesh)
+    # Multiple steps so EMA'd stats feed back into the forward.
+    for _ in range(steps):
+        state_g, metrics_g = global_step(state_g, batch)
+        state_s, metrics_s = dp_step(state_s, sharded)
+    return state_g, metrics_g, state_s, metrics_s
 
+
+def _assert_tree_close(a_tree, b_tree, rtol, atol):
+    for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol
+        )
+
+
+@pytest.mark.slow
+def test_sharded_train_step_matches_global_batch():
+    """SURVEY §4.4 parity, SGD: the per-replica step with pmean'd moments,
+    gradients, and metrics reproduces single-device global-batch numerics.
+
+    SGD's update is linear in the gradient, so float summation-order noise
+    in a pmean (~1e-7) stays ~lr·1e-7 in the params and tight tolerances
+    hold.  (Adam would normalize near-zero gradients to full ±lr, amplifying
+    reassociation noise into sign flips — covered by the looser Adam test
+    below.)
+    """
+    state_g, metrics_g, state_s, metrics_s = _run_parity(
+        optax.sgd(1e-2, momentum=0.9)
+    )
     for k in metrics_g:
         np.testing.assert_allclose(
             float(metrics_s[k]), float(metrics_g[k]), rtol=1e-5, atol=1e-6
         )
-    flat_g = jax.tree.leaves(state_g.params)
-    flat_s = jax.tree.leaves(state_s.params)
-    for a, b in zip(flat_s, flat_g):
+    _assert_tree_close(state_s.params, state_g.params, rtol=1e-5, atol=1e-6)
+    _assert_tree_close(
+        state_s.batch_stats, state_g.batch_stats, rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_sharded_adam_step_matches_global_batch_semantics():
+    """Adam (the digits recipe): metrics and batch stats must match tightly;
+    params only loosely — Adam's ``m/(sqrt(v)+eps)`` maps a near-zero
+    gradient to a full ±lr step, so float reassociation noise across the 8
+    pmean'd replicas can flip a whole update's sign.  The loose bound is
+    2·steps·lr.
+    """
+    lr = 1e-3
+    steps = 2
+    state_g, metrics_g, state_s, metrics_s = _run_parity(
+        adam_l2(lr, 5e-4), steps=steps
+    )
+    # Step-2 metrics/stats pass through step-1 params, which can carry a few
+    # sign-flipped ±lr updates — tolerances are an order looser than SGD's.
+    for k in metrics_g:
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            float(metrics_s[k]), float(metrics_g[k]), rtol=1e-3, atol=1e-5
         )
-    for a, b in zip(
-        jax.tree.leaves(state_s.batch_stats), jax.tree.leaves(state_g.batch_stats)
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
-        )
+    # Absolute-only for stats: near-zero covariance entries make relative
+    # error meaningless, and step-1 param flips perturb activations at ~lr.
+    _assert_tree_close(
+        state_s.batch_stats, state_g.batch_stats, rtol=0.0, atol=1e-3
+    )
+    _assert_tree_close(
+        state_s.params, state_g.params, rtol=0.0, atol=2 * steps * lr
+    )
 
 
 def test_shard_batch_places_leading_axis_across_mesh():
