@@ -1,33 +1,78 @@
 """Canonical perf driver: jitted DWT train-step throughput on one chip.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N, ...}``
+including an analytic MFU estimate (XLA cost-analysis FLOPs when available,
+closed-form fallback otherwise, divided by the chip's peak bf16 FLOP/s).
+
+Flagship benchmark (default): ResNet50-DWT OfficeHome train step at the
+reference recipe — 18 images per domain stream (54-image concatenated
+forward, ``resnet50_dwt_mec_officehome.py:500-502``), 224x224 crops,
+group_size=4, bf16 compute with f32 whitening/BN statistics.
+``--model lenet`` measures the digits step (32+32, ``usps_mnist.py:333-336``).
 
 The reference publishes no throughput numbers (BASELINE.md) — the baseline
-is established de novo, so ``vs_baseline`` is this run's value normalized by
-``BASELINE_IMGS_PER_SEC`` below (the first recorded TPU number; ratio > 1.0
-means faster than that round's result).
+is established de novo; ``vs_baseline`` normalizes by the first recorded TPU
+number below.
 
-Flagship benchmark: LeNet-DWT digits train step at the reference's batch
-size (32 source + 32 target, ``usps_mnist.py:333-336``), group_size=4.
-Selectable with ``--model resnet50`` once the ResNet path lands to measure
-the OfficeHome configuration (18/18/18 thirds, ``resnet50…py:500-502``).
+Robustness: the environment reaches the single TPU chip through an
+experimental relay whose backend init can fail (Unavailable) or hang
+outright when the chip claim is wedged.  Backend init is therefore probed in
+a *subprocess* with a timeout, retried once, and on failure the benchmark
+re-execs itself on CPU in a clean environment (relay vars stripped) so the
+driver always records a parsable measurement with an honest ``backend``
+field and a diagnostic.
 """
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+# First real-TPU measurement anchors vs_baseline; None -> vs_baseline=1.0.
+BASELINE_IMGS_PER_SEC = None
 
-# First real-TPU measurement (round 2, LeNet-DWT bs32, TPU v5e via axon).
-# Update only to re-anchor; vs_baseline compares against this.
-BASELINE_IMGS_PER_SEC = None  # set after first TPU run; None -> vs_baseline=1.0
+_RELAY_VAR = "PALLAS_AXON_POOL_IPS"
+# Backend init + one tiny compile (first compile 20-40s); overridable so a
+# wedged-relay environment fails fast when the operator knows it's down.
+_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+
+# Peak dense bf16 FLOP/s per chip by device-kind substring (public specs).
+_PEAK_FLOPS = [
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# Analytic fallback FLOPs per image for one *training* step (fwd + bwd ~= 3x
+# fwd): ResNet50 fwd at 224x224 is ~4.1e9 MAC-derived FLOPs (8.2e9 FLOPs
+# counting mul+add); LeNet-DWT fwd is ~6.6e6 FLOPs.  Used only when XLA
+# cost analysis is unavailable.
+_ANALYTIC_TRAIN_FLOPS_PER_IMG = {
+    "resnet50": 3 * 8.2e9,
+    "lenet": 3 * 1.3e7,
+}
 
 
 def _bench_lenet(steps: int, batch: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from dwt_tpu.nn import LeNetDWT
     from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
 
@@ -51,6 +96,10 @@ def _bench_lenet(steps: int, batch: int):
 
 
 def _bench_resnet50(steps: int, batch: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from dwt_tpu.nn import ResNetDWT
     from dwt_tpu.train import (
         create_train_state,
@@ -71,7 +120,7 @@ def _bench_resnet50(steps: int, batch: int):
             rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16
         ),
     }
-    model = ResNetDWT.resnet50(num_classes=65, dtype=jnp.bfloat16)
+    model = ResNetDWT.resnet50(num_classes=65, group_size=4, dtype=jnp.bfloat16)
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
@@ -81,8 +130,35 @@ def _bench_resnet50(steps: int, batch: int):
     return _time_steps(step, state, b, steps, imgs_per_step=3 * batch)
 
 
+def _compile_with_flops(step, state, batch):
+    """AOT-compile the step once; return (callable, flops or None).
+
+    Reusing the compiled executable for timing avoids paying the (20-40s+)
+    XLA compile twice; cost analysis comes from the same artifact.
+    """
+    try:
+        compiled = step.lower(state, batch).compile()
+    except Exception as e:  # relay/remote-compile may not support AOT
+        print(f"bench: AOT compile unavailable ({e!r})", file=sys.stderr)
+        return step, None
+    flops = None
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        f = float(analysis.get("flops", 0.0))
+        flops = f if f > 0 else None
+    except Exception as e:
+        print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
+    return compiled, flops
+
+
 def _time_steps(step, state, batch, steps, imgs_per_step):
-    # Warmup: compile + 2 steady-state steps.
+    import jax
+    import numpy as np
+
+    step, flops_per_step = _compile_with_flops(step, state, batch)
+    # Warmup: 3 steady-state steps (compile already done when AOT worked).
     state, m = step(state, batch)
     jax.block_until_ready(m)
     for _ in range(2):
@@ -94,36 +170,140 @@ def _time_steps(step, state, batch, steps, imgs_per_step):
     jax.block_until_ready(m)
     dt = time.perf_counter() - t0
     assert np.isfinite(float(m["loss"])), "non-finite loss in bench"
-    return imgs_per_step * steps / dt, dt / steps
+    return imgs_per_step * steps / dt, dt / steps, flops_per_step
+
+
+def _probe_backend() -> bool:
+    """Initialize the default backend in a subprocess with a timeout.
+
+    Returns True if `jax.devices()` + one tiny computation complete; False on
+    nonzero exit, timeout, or hang (the wedged-claim mode observed on the
+    relay).
+    """
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "print(jax.default_backend()); "
+        "print(float(jnp.ones((8, 8)).sum()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=_PROBE_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"bench: backend probe hung >{_PROBE_TIMEOUT_S}s "
+            "(wedged relay claim?)",
+            file=sys.stderr,
+        )
+        return False
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        print(
+            "bench: backend probe failed rc=%d: %s"
+            % (proc.returncode, " | ".join(tail)),
+            file=sys.stderr,
+        )
+        return False
+    return True
+
+
+def _reexec_cpu_fallback(args) -> int:
+    """Re-exec this script on CPU in a clean env; returns the child's rc."""
+    env = {k: v for k, v in os.environ.items() if k != _RELAY_VAR}
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable,
+        os.path.abspath(__file__),
+        "--model",
+        # Full-size ResNet50 at batch 54 is minutes/step on CPU — the
+        # fallback measures the digits model so the driver still records a
+        # real number in bounded time.
+        "lenet",
+        "--steps",
+        str(min(args.steps, 20)),
+        "--no-probe",
+        "--fallback-note",
+        "tpu backend init failed twice; clean-env cpu rerun",
+    ]
+    return subprocess.call(cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["lenet", "resnet50"], default="lenet")
-    ap.add_argument("--steps", type=int, default=50)
-    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument(
+        "--model", choices=["lenet", "resnet50"], default="resnet50"
+    )
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument(
+        "--batch",
+        type=int,
+        default=None,
+        help="per-domain-stream batch (default: reference recipe: "
+        "18 for resnet50, 32 for lenet)",
+    )
+    ap.add_argument(
+        "--no-probe",
+        action="store_true",
+        help="skip the subprocess backend probe (fallback path)",
+    )
+    ap.add_argument("--fallback-note", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    if not args.no_probe:
+        ok = _probe_backend()
+        if not ok:
+            print("bench: retrying backend probe once...", file=sys.stderr)
+            time.sleep(10)
+            ok = _probe_backend()
+        if not ok:
+            sys.exit(_reexec_cpu_fallback(args))
+
+    import jax
+
     if args.model == "lenet":
-        imgs_per_sec, step_time = _bench_lenet(args.steps, args.batch)
+        batch = args.batch or 32
+        imgs_per_sec, step_time, flops = _bench_lenet(args.steps, batch)
         metric = "lenet_dwt_train_imgs_per_sec"
     else:
-        imgs_per_sec, step_time = _bench_resnet50(args.steps, max(args.batch, 18))
+        batch = args.batch or 18
+        imgs_per_sec, step_time, flops = _bench_resnet50(args.steps, batch)
         metric = "resnet50_dwt_train_imgs_per_sec"
 
-    vs = 1.0 if BASELINE_IMGS_PER_SEC is None else imgs_per_sec / BASELINE_IMGS_PER_SEC
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(imgs_per_sec, 2),
-                "unit": "imgs/sec",
-                "vs_baseline": round(vs, 4),
-                "step_time_ms": round(step_time * 1e3, 3),
-                "backend": jax.default_backend(),
-            }
-        )
+    flops_source = "xla_cost_analysis"
+    if flops is None:
+        flops_source = "analytic_estimate"
+        n_imgs = (2 if args.model == "lenet" else 3) * batch
+        flops = _ANALYTIC_TRAIN_FLOPS_PER_IMG[args.model] * n_imgs
+
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind)
+    mfu = None
+    if peak is not None and flops:
+        mfu = flops / step_time / peak
+
+    vs = (
+        1.0
+        if BASELINE_IMGS_PER_SEC is None
+        else imgs_per_sec / BASELINE_IMGS_PER_SEC
     )
+    record = {
+        "metric": metric,
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(vs, 4),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "mfu": None if mfu is None else round(mfu, 4),
+        "flops_per_step": flops,
+        "flops_source": flops_source,
+        "backend": jax.default_backend(),
+        "device_kind": device_kind,
+    }
+    if args.fallback_note:
+        record["fallback"] = args.fallback_note
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

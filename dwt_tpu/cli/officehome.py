@@ -31,9 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "as in reference (:398)")
     p.add_argument("--lr", type=float, default=d.lr)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
-    p.add_argument("--sgd_momentum", type=float, default=0.5,
+    p.add_argument("--sgd_momentum", type=float, default=None,
                    help="reference default 0.5 is unused there; the actual "
-                        "optimizer momentum is 0.9 (:590), which dwt_tpu uses")
+                        "optimizer momentum is 0.9 (:590), which dwt_tpu uses "
+                        "when the flag is not given (None sentinel, so an "
+                        "explicit 0.5 is honored)")
     p.add_argument("--running_momentum", type=float, default=d.running_momentum)
     p.add_argument("--lambda_mec_loss", type=float, default=d.lambda_mec_loss)
     p.add_argument("--log_interval", type=int, default=d.log_interval)
@@ -58,8 +60,9 @@ def config_from_args(args: argparse.Namespace) -> OfficeHomeConfig:
     fields = {f.name for f in OfficeHomeConfig.__dataclass_fields__.values()}
     kwargs = {k: v for k, v in vars(args).items() if k in fields}
     # The reference's *effective* SGD momentum is 0.9 regardless of the
-    # (dead) --sgd_momentum flag; honor an explicit override only.
-    if kwargs.get("sgd_momentum") == 0.5:
+    # (dead) --sgd_momentum flag; None (flag absent) maps to 0.9 so every
+    # explicitly-passed value — including 0.5 — is honored.
+    if kwargs.get("sgd_momentum") is None:
         kwargs["sgd_momentum"] = 0.9
     return OfficeHomeConfig(**kwargs)
 

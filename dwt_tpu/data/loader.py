@@ -78,29 +78,44 @@ def infinite(
 
 
 def prefetch_to_device(
-    iterator: Iterable, size: int = 2, device=None
+    iterator: Iterable,
+    size: int = 2,
+    device=None,
+    transfer: Optional[Callable] = None,
 ) -> Iterator:
     """Background-thread prefetch of ``size`` batches onto the device.
 
     Overlaps host-side batch assembly/augmentation with device compute —
-    the TPU-native replacement for DataLoader worker processes.
+    the TPU-native replacement for DataLoader worker processes.  Both train
+    loops route their batch streams through this (``dwt_tpu.train.loop``).
+
+    ``transfer`` overrides the default ``jax.device_put(item, device)`` —
+    pass a sharding-aware placement (e.g. ``shard_batch``) for DP runs.
+    ``device`` may be a ``jax.Device`` or any ``jax.sharding.Sharding``.
     """
     import jax
 
+    put = transfer or (lambda item: jax.device_put(item, device))
     q: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
 
     def producer():
         try:
             for item in iterator:
-                q.put(jax.device_put(item, device))
-        finally:
-            q.put(sentinel)
+                q.put(put(item))
+        except BaseException as e:  # re-raised in the consumer below
+            q.put((sentinel, e))
+            return
+        q.put((sentinel, None))
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
     while True:
         item = q.get()
-        if item is sentinel:
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is sentinel:
+            if item[1] is not None:
+                # Batch assembly/augmentation/placement failures must abort
+                # the training run, not silently truncate the stream.
+                raise item[1]
             return
         yield item

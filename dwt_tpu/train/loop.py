@@ -23,7 +23,6 @@ from typing import Callable, Iterator, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from dwt_tpu.config import DigitsConfig, OfficeHomeConfig
 from dwt_tpu.data import (
@@ -40,6 +39,7 @@ from dwt_tpu.data import (
     infinite,
     load_mnist,
     load_usps,
+    prefetch_to_device,
     random_affine,
 )
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
@@ -74,10 +74,16 @@ def _synthetic_classification_arrays(
 
 
 def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callable]:
-    """Build (model, wrap_step, wrap_batch) for single-device or DP runs."""
+    """Build (model, wrap_step, wrap_batch) for single-device or DP runs.
+
+    The returned ``model`` carries the mesh ``axis_name`` when DP is on, so
+    it must only be used *inside* the sharded step — init must go through an
+    axis-free twin (same param/stat shapes), or the traced ``pmean`` runs
+    outside any mesh context and raises "unbound axis name".
+    """
     if not getattr(cfg, "data_parallel", False) or jax.device_count() == 1:
         model = step_fn_builder(axis_name=None, **model_kw)
-        return model, jax.jit, lambda b: b
+        return model, jax.jit, jax.device_put
     from dwt_tpu.parallel import (
         DATA_AXIS,
         make_mesh,
@@ -85,6 +91,13 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
         shard_batch,
     )
 
+    bs = getattr(cfg, "source_batch_size", None)
+    if bs is not None and bs % jax.device_count() != 0:
+        raise ValueError(
+            f"--data_parallel shards the per-domain batch over "
+            f"{jax.device_count()} devices, so --source_batch_size "
+            f"(= --target_batch_size) must be divisible by it; got {bs}"
+        )
     mesh = make_mesh()
     model = step_fn_builder(axis_name=DATA_AXIS, **model_kw)
     wrap = lambda fn: make_sharded_train_step(fn, mesh, axis_name=DATA_AXIS)
@@ -166,9 +179,8 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
 
     # Pre-step MultiStepLR over epochs → step-count boundaries at
     # (milestone-1)*steps_per_epoch (SURVEY §7 scheduler quirk).
-    schedule = optax.piecewise_constant_schedule(
-        cfg.lr,
-        {max(m - 1, 0) * steps_per_epoch: cfg.lr_gamma for m in cfg.lr_milestones},
+    schedule = multistep_schedule(
+        cfg.lr, cfg.lr_milestones, cfg.lr_gamma, scale=steps_per_epoch
     )
     tx = adam_l2(schedule, cfg.weight_decay)
 
@@ -182,7 +194,11 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
 
     model, wrap, wrap_batch = _maybe_dp(cfg, build_model, {})
     sample = jnp.zeros((2, bs, 28, 28, 1), jnp.float32)
-    state = create_train_state(model, jax.random.key(cfg.seed), sample, tx)
+    # Init with an axis-free twin: identical param/stat shapes, no pmean
+    # traced outside the mesh (see _maybe_dp docstring).
+    state = create_train_state(
+        build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
+    )
     start_epoch = 0
     if cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None:
         state = restore_state(cfg.ckpt_dir, state)
@@ -199,6 +215,13 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     )
     eval_step = jax.jit(make_eval_step(build_model(axis_name=None)))
 
+    if start_epoch >= cfg.epochs:
+        # Resumed from a finished run: report the restored model's accuracy
+        # instead of silently returning 0.0 without evaluating.
+        result = _evaluate(eval_step, state, target_test_ds, cfg.test_batch_size)
+        logger.log("test", int(state.step), epoch=start_epoch, **result)
+        return result["accuracy"]
+
     acc = 0.0
     for epoch in range(start_epoch, cfg.epochs):
         source_iter = batch_iterator(
@@ -207,14 +230,21 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         target_iter = batch_iterator(
             target_ds, bs, shuffle=True, seed=cfg.seed + 1, epoch=epoch
         )
-        for i, ((sx, sy), (txi, _)) in enumerate(zip(source_iter, target_iter)):
-            batch = wrap_batch(
-                {
-                    "source_x": jnp.asarray(sx),
-                    "source_y": jnp.asarray(sy),
-                    "target_x": jnp.asarray(txi),
+
+        def epoch_batches():
+            for (sx, sy), (txi, _) in zip(source_iter, target_iter):
+                yield {
+                    "source_x": np.asarray(sx, np.float32),
+                    "source_y": np.asarray(sy),
+                    "target_x": np.asarray(txi, np.float32),
                 }
-            )
+
+        # Host-side batch assembly overlaps device compute: the prefetch
+        # thread stages (and places) the next batches while the step runs.
+        batches = prefetch_to_device(
+            epoch_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
+        )
+        for i, batch in enumerate(batches):
             state, metrics = train_step(state, batch)
             if i % cfg.log_interval == 0:
                 logger.log(
@@ -326,7 +356,10 @@ def run_officehome(
     model, wrap, wrap_batch = _maybe_dp(cfg, build_model, {})
     size = cfg.img_crop_size
     sample = jnp.zeros((3, bs, size, size, 3), jnp.float32)
-    state = create_train_state(model, jax.random.key(cfg.seed), sample, tx)
+    # Axis-free init twin (see _maybe_dp docstring).
+    state = create_train_state(
+        build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
+    )
 
     if cfg.resnet_path and not cfg.synthetic:
         import os
@@ -377,18 +410,26 @@ def run_officehome(
                                  epoch=e)
     )
 
-    acc = 0.0
-    for it in range(start_iter, cfg.num_iters):
-        sx, sy = next(source_stream)
-        tx_img, tx_aug, _ = next(target_stream)
-        batch = wrap_batch(
-            {
-                "source_x": jnp.asarray(sx),
-                "source_y": jnp.asarray(sy),
-                "target_x": jnp.asarray(tx_img),
-                "target_aug_x": jnp.asarray(tx_aug),
+    def train_batches():
+        # Finite (num_iters - start_iter) stream so the prefetch producer
+        # thread terminates with the loop.
+        for _ in range(start_iter, cfg.num_iters):
+            sx, sy = next(source_stream)
+            tx_img, tx_aug, _ = next(target_stream)
+            yield {
+                "source_x": np.asarray(sx, np.float32),
+                "source_y": np.asarray(sy),
+                "target_x": np.asarray(tx_img, np.float32),
+                "target_aug_x": np.asarray(tx_aug, np.float32),
             }
-        )
+
+    # Overlap host-side decode/augmentation with device compute (the aug
+    # pipeline is the expensive host stage for OfficeHome).
+    batches = prefetch_to_device(
+        train_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
+    )
+    acc = 0.0
+    for it, batch in enumerate(batches, start=start_iter):
         state, metrics = train_step(state, batch)
         if it % cfg.log_interval == 0:
             logger.log(

@@ -17,6 +17,7 @@ def multistep_schedule(
     milestones: Sequence[int],
     gamma: float = 0.1,
     pre_step: bool = True,
+    scale: int = 1,
 ) -> optax.Schedule:
     """torch ``MultiStepLR`` as an optax schedule over the *step* counter.
 
@@ -26,9 +27,14 @@ def multistep_schedule(
     milestones ``[50, 80]`` take effect at epoch 49/79.  ``pre_step=True``
     reproduces that resulting lr sequence (SURVEY §7 quirks list — replicate
     the sequence, not the call order).
+
+    ``scale`` converts milestone units into optimizer steps (e.g. pass
+    ``steps_per_epoch`` when milestones are epochs, as in the digits recipe;
+    leave 1 when milestones are already iteration counts, as for
+    OfficeHome).
     """
     shift = 1 if pre_step else 0
-    boundaries = {max(m - shift, 0): gamma for m in milestones}
+    boundaries = {max(m - shift, 0) * scale: gamma for m in milestones}
     return optax.piecewise_constant_schedule(base_lr, boundaries)
 
 

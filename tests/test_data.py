@@ -177,6 +177,39 @@ def test_batch_iterator_drop_last_shuffle_shard():
     assert sum(len(y) for y in dropped) == 8
 
 
+def test_prefetch_to_device_orders_and_places():
+    import jax
+
+    from dwt_tpu.data import prefetch_to_device
+
+    items = [{"x": np.full((2, 2), i, np.float32)} for i in range(5)]
+    out = list(prefetch_to_device(iter(items), size=2))
+    assert len(out) == 5
+    for i, item in enumerate(out):
+        assert isinstance(item["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(item["x"]), items[i]["x"])
+
+    # Custom transfer hook (the DP shard_batch path).
+    calls = []
+
+    def transfer(item):
+        calls.append(True)
+        return jax.device_put(item)
+
+    out = list(prefetch_to_device(iter(items), size=2, transfer=transfer))
+    assert len(calls) == 5 and len(out) == 5
+
+    # Producer-side failures must propagate, not truncate the stream.
+    def bad_batches():
+        yield items[0]
+        raise RuntimeError("corrupt image")
+
+    stream = prefetch_to_device(bad_batches(), size=2)
+    next(stream)
+    with pytest.raises(RuntimeError, match="corrupt image"):
+        next(stream)
+
+
 def test_infinite_restarts_epochs():
     images = np.arange(4, dtype=np.float32)[:, None]
     ds = ArrayDataset(images, np.arange(4))

@@ -58,6 +58,45 @@ def test_digits_cli_synthetic_with_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_digits_loop_data_parallel(tmp_path):
+    """Loop-level DP smoke on the 8-device CPU mesh: init must be axis-free
+    (the DP model's pmean only traces inside shard_map), one epoch trains,
+    accuracy evaluates."""
+    from dwt_tpu.cli.usps_mnist import main
+
+    acc = main(
+        [
+            "--synthetic",
+            "--synthetic_size", "32",
+            "--source_batch_size", "8",
+            "--target_batch_size", "8",
+            "--test_batch_size", "16",
+            "--group_size", "4",
+            "--epochs", "1",
+            "--data_parallel",
+        ]
+    )
+    assert 0.0 <= acc <= 100.0
+
+
+def test_digits_loop_dp_rejects_indivisible_batch():
+    from dwt_tpu.cli.usps_mnist import main
+
+    with pytest.raises(ValueError, match="divisible"):
+        main(
+            [
+                "--synthetic",
+                "--synthetic_size", "30",
+                "--source_batch_size", "6",
+                "--target_batch_size", "6",
+                "--group_size", "4",
+                "--epochs", "1",
+                "--data_parallel",
+            ]
+        )
+
+
+@pytest.mark.slow
 def test_officehome_cli_synthetic(tmp_path):
     from dwt_tpu.cli.officehome import main
 
