@@ -33,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--synthetic_size", type=int, default=d.synthetic_size)
     p.add_argument("--data_parallel", action="store_true")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host bring-up: call jax.distributed.initialize(); "
+                        "launch the same command on every host")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--bf16", action="store_true")

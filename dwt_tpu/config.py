@@ -40,6 +40,7 @@ class DigitsConfig:
     synthetic: bool = False  # run on generated data (no dataset files)
     synthetic_size: int = 256
     data_parallel: bool = False  # shard over all local devices
+    distributed: bool = False  # multi-host: jax.distributed.initialize()
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     bf16: bool = False
@@ -79,6 +80,7 @@ class OfficeHomeConfig:
     synthetic: bool = False
     synthetic_size: int = 64
     data_parallel: bool = False
+    distributed: bool = False  # multi-host: jax.distributed.initialize()
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
     bf16: bool = False

@@ -37,7 +37,11 @@ def batch_iterator(
     * ``drop_last=True`` by default — the reference relies on it for the
       exact halves/thirds batch split (``usps_mnist.py:361,378``; SURVEY §7);
     * ``shard=(index, count)``: this process sees every ``count``-th sample
-      (after the seeded shuffle), the multi-host DP split;
+      (after the seeded shuffle), the multi-host DP split.  With
+      ``drop_last=True`` the epoch is first truncated to a multiple of
+      ``count * batch_size`` so EVERY process yields the SAME number of
+      batches — otherwise a ragged tail gives one process an extra
+      collective train step and the job deadlocks;
     * ``seed``/``epoch`` make shuffling deterministic per epoch.
     """
     n = len(dataset)
@@ -46,6 +50,9 @@ def batch_iterator(
         order = np.random.default_rng((seed, epoch)).permutation(n)
     if shard is not None:
         index, count = shard
+        if drop_last:
+            usable = n - n % (count * batch_size)
+            order = order[:usable]
         order = order[index::count]
     stop = len(order) - (len(order) % batch_size if drop_last else 0)
     for start in range(0, stop, batch_size):

@@ -56,9 +56,25 @@ def make_sharded_train_step(
 
 
 def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
-    """Place every batch leaf with its leading axis sharded over the mesh."""
+    """Place every batch leaf with its leading axis sharded over the mesh.
+
+    Single-process: a plain sharded ``device_put``.  Multi-host (the mesh
+    spans devices of several processes): every process passes its LOCAL
+    shard — the slice its ``batch_iterator(shard=(process_index,
+    process_count))`` produced — and the leaves are assembled into global
+    arrays whose leading axis is the concatenation over processes.
+    """
     sharding = NamedSharding(mesh, P(axis_name))
-    return jax.device_put(batch, sharding)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    import numpy as np
+
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            sharding, np.asarray(a)
+        ),
+        batch,
+    )
 
 
 def replicate_state(state: Any, mesh: Mesh) -> Any:

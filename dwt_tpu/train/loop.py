@@ -73,6 +73,63 @@ def _synthetic_classification_arrays(
     return images, labels.astype(np.int64)
 
 
+def _maybe_init_distributed(cfg) -> None:
+    """Multi-host bring-up when requested (``--distributed``).
+
+    Bring-up note: launch the SAME command on every host of the slice/pod
+    (e.g. ``gcloud ... tpu-vm ssh --worker=all --command="python -m
+    dwt_tpu.cli.officehome --distributed --data_parallel ..."``).
+    ``jax.distributed.initialize`` auto-detects coordinator/rank on Cloud
+    TPU; each process then loads its own 1/process_count shard of every
+    epoch (``batch_iterator(shard=...)``), the global batch is assembled by
+    ``shard_batch`` via ``make_array_from_process_local_data``, and eval
+    counters are summed across processes in ``_evaluate``.
+    """
+    if not getattr(cfg, "distributed", False):
+        return
+    # Must not touch any backend-initializing API (jax.process_count,
+    # jax.devices, ...) before initialize() — probing would flip
+    # backends_are_initialized and make initialize() raise.
+    if jax.distributed.is_initialized():
+        return
+    from dwt_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
+
+
+def _multihost_data_split(cfg, bs: int) -> Tuple[int, Optional[Tuple[int, int]]]:
+    """``(local_batch_size, shard)`` for this process.
+
+    Single-process: ``(bs, None)``.  Multi-host: the GLOBAL per-domain batch
+    stays at the configured reference value; each process loads a
+    ``1/process_count`` slice and ``shard_batch`` assembles the global
+    arrays — which requires the sharded step, so ``--data_parallel`` is
+    mandatory on multi-host.
+    """
+    n = jax.process_count()
+    if n == 1:
+        return bs, None
+    if not getattr(cfg, "data_parallel", False):
+        raise ValueError(
+            "multi-host runs require --data_parallel: without the sharded "
+            "step there is no gradient/moment sync and every process would "
+            "silently train its own divergent model"
+        )
+    if bs % n != 0:
+        raise ValueError(
+            f"--source_batch_size={bs} must be divisible by the {n} "
+            f"participating processes"
+        )
+    return bs // n, (jax.process_index(), n)
+
+
+def _process_shard() -> Optional[Tuple[int, int]]:
+    """This process's eval ``shard=`` (multi-host test-set split), or None."""
+    if jax.process_count() > 1:
+        return (jax.process_index(), jax.process_count())
+    return None
+
+
 def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callable]:
     """Build (model, wrap_step, wrap_batch) for single-device or DP runs.
 
@@ -105,9 +162,13 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
 
 
 def _evaluate(eval_step, state: TrainState, dataset, batch_size: int) -> dict:
+    """Accumulate eval counters; multi-host runs shard the test set per
+    process and sum the counters across processes (the cross-replica sum
+    of the reference ``test()`` accumulators, SURVEY §5)."""
     loss_sum, correct, count = 0.0, 0, 0
     for x, y in batch_iterator(
-        dataset, batch_size, shuffle=False, drop_last=False
+        dataset, batch_size, shuffle=False, drop_last=False,
+        shard=_process_shard(),
     ):
         out = eval_step(
             state.params, state.batch_stats, jnp.asarray(x), jnp.asarray(y)
@@ -115,6 +176,13 @@ def _evaluate(eval_step, state: TrainState, dataset, batch_size: int) -> dict:
         loss_sum += float(out["loss_sum"])
         correct += int(out["correct"])
         count += int(out["count"])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        sums = multihost_utils.process_allgather(
+            np.asarray([loss_sum, float(correct), float(count)])
+        ).sum(axis=0)
+        loss_sum, correct, count = float(sums[0]), int(sums[1]), int(sums[2])
     return {
         "loss": loss_sum / max(count, 1),
         "accuracy": 100.0 * correct / max(count, 1),
@@ -164,6 +232,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     """Train LeNet-DWT; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    _maybe_init_distributed(cfg)
     if cfg.source == cfg.target:
         raise ValueError("source and target datasets can not be the same")
     if cfg.source_batch_size != cfg.target_batch_size:
@@ -172,7 +241,8 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         )
 
     source_ds, target_ds, target_test_ds = _digits_datasets(cfg)
-    bs = cfg.source_batch_size
+    bs = cfg.source_batch_size  # GLOBAL per-domain batch (reference value)
+    local_bs, shard = _multihost_data_split(cfg, bs)
     steps_per_epoch = min(len(source_ds), len(target_ds)) // bs
     if steps_per_epoch == 0:
         raise ValueError("datasets smaller than one batch")
@@ -225,10 +295,12 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     acc = 0.0
     for epoch in range(start_epoch, cfg.epochs):
         source_iter = batch_iterator(
-            source_ds, bs, shuffle=True, seed=cfg.seed, epoch=epoch
+            source_ds, local_bs, shuffle=True, seed=cfg.seed, epoch=epoch,
+            shard=shard,
         )
         target_iter = batch_iterator(
-            target_ds, bs, shuffle=True, seed=cfg.seed + 1, epoch=epoch
+            target_ds, local_bs, shuffle=True, seed=cfg.seed + 1, epoch=epoch,
+            shard=shard,
         )
 
         def epoch_batches():
@@ -326,9 +398,11 @@ def run_officehome(
     """Train ResNet-DWT with MEC; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    _maybe_init_distributed(cfg)
 
     source_ds, target_ds, test_ds = _officehome_datasets(cfg)
     bs = cfg.source_batch_size  # target loader uses source bs too (:565)
+    local_bs, shard = _multihost_data_split(cfg, bs)
 
     head_lr = multistep_schedule(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
     backbone_lr = multistep_schedule(
@@ -402,12 +476,12 @@ def run_officehome(
     collect_step = jax.jit(make_stat_collection_step(eval_model, num_domains=3))
 
     source_stream = infinite(
-        lambda e: batch_iterator(source_ds, bs, shuffle=True, seed=cfg.seed,
-                                 epoch=e)
+        lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
+                                 seed=cfg.seed, epoch=e, shard=shard)
     )
     target_stream = infinite(
-        lambda e: batch_iterator(target_ds, bs, shuffle=True, seed=cfg.seed + 1,
-                                 epoch=e)
+        lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
+                                 seed=cfg.seed + 1, epoch=e, shard=shard)
     )
 
     def train_batches():

@@ -176,6 +176,18 @@ def test_batch_iterator_drop_last_shuffle_shard():
     ]
     assert sum(len(y) for y in dropped) == 8
 
+    # Ragged shard sizes must still yield EQUAL batch counts per process
+    # (a mismatch would hang the collective train step): 63 samples over 2
+    # shards at local batch 16 -> exactly 1 batch each, both shards.
+    big = ArrayDataset(
+        np.arange(63, dtype=np.float32)[:, None], np.arange(63)
+    )
+    counts = [
+        len(list(batch_iterator(big, 16, shuffle=True, shard=(i, 2))))
+        for i in range(2)
+    ]
+    assert counts == [1, 1]
+
 
 def test_prefetch_to_device_orders_and_places():
     import jax

@@ -1,0 +1,248 @@
+"""Module-level parity: LeNetDWT vs a torch twin of the reference model.
+
+The strongest accuracy-parity evidence obtainable without datasets: a
+minimal torch reimplementation of the reference LeNet's behavior
+(``usps_mnist.py:196-278`` — dual whitening/BN branches with a shared
+affine, halves split in train, target-branch routing in eval), weight-tied
+to ``LeNetDWT``, must produce the same train- and eval-mode outputs and the
+same running-stat updates to float tolerance.
+
+The torch twin is built here from the SURVEY formulas (NCHW, grouped
+Cholesky whitening via ``bmm``/``cholesky``/``inverse``/grouped conv2d),
+not imported from the reference.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dwt_tpu.nn import LeNetDWT  # noqa: E402
+
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+
+class _TorchWhiten(nn.Module):
+    """Grouped Cholesky whitening, NCHW (reference ``whitening.py:37-61``)."""
+
+    def __init__(self, c, group_size, momentum=0.1, eps=1e-3):
+        super().__init__()
+        g = min(c, group_size)
+        self.ng, self.g, self.eps, self.momentum = c // g, g, eps, momentum
+        self.register_buffer("running_mean", torch.zeros(1, c, 1, 1))
+        self.register_buffer("running_cov", torch.ones(self.ng, g, g))
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        if self.training:
+            m = x.mean(dim=(0, 2, 3)).view(1, c, 1, 1)
+        else:
+            m = self.running_mean
+        xn = x - m
+        t = xn.permute(1, 0, 2, 3).reshape(self.ng, self.g, -1)
+        eye = torch.eye(self.g)
+        if self.training:
+            cov = torch.bmm(t, t.transpose(1, 2)) / t.shape[-1]
+            shrunk = (1 - self.eps) * cov + self.eps * eye
+        else:
+            shrunk = (1 - self.eps) * self.running_cov + self.eps * eye
+        inv = torch.inverse(torch.linalg.cholesky(shrunk))
+        weight = inv.reshape(c, self.g, 1, 1)
+        y = F.conv2d(xn, weight, groups=self.ng)
+        if self.training:
+            with torch.no_grad():
+                self.running_mean.mul_(1 - self.momentum).add_(
+                    self.momentum * m
+                )
+                self.running_cov.mul_(1 - self.momentum).add_(
+                    self.momentum * cov
+                )
+        return y
+
+
+class _TorchLeNetDWT(nn.Module):
+    """Behavioral twin of the reference LeNet (dual-branch, shared affine)."""
+
+    def __init__(self, group_size=4):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 32, 5, padding=2)
+        self.w1 = nn.ModuleList([_TorchWhiten(32, group_size) for _ in range(2)])
+        self.g1 = nn.Parameter(torch.ones(1, 32, 1, 1))
+        self.b1 = nn.Parameter(torch.zeros(1, 32, 1, 1))
+        self.conv2 = nn.Conv2d(32, 48, 5, padding=2)
+        self.w2 = nn.ModuleList([_TorchWhiten(48, group_size) for _ in range(2)])
+        self.g2 = nn.Parameter(torch.ones(1, 48, 1, 1))
+        self.b2 = nn.Parameter(torch.zeros(1, 48, 1, 1))
+        self.fc3 = nn.Linear(2352, 100)
+        self.n3 = nn.ModuleList(
+            [nn.BatchNorm1d(100, affine=False) for _ in range(2)]
+        )
+        self.g3 = nn.Parameter(torch.ones(1, 100))
+        self.b3 = nn.Parameter(torch.zeros(1, 100))
+        self.fc4 = nn.Linear(100, 100)
+        self.n4 = nn.ModuleList(
+            [nn.BatchNorm1d(100, affine=False) for _ in range(2)]
+        )
+        self.g4 = nn.Parameter(torch.ones(1, 100))
+        self.b4 = nn.Parameter(torch.zeros(1, 100))
+        self.fc5 = nn.Linear(100, 10)
+        self.n5 = nn.ModuleList(
+            [nn.BatchNorm1d(10, affine=False) for _ in range(2)]
+        )
+        self.g5 = nn.Parameter(torch.ones(1, 10))
+        self.b5 = nn.Parameter(torch.zeros(1, 10))
+
+    def _branch(self, mods, x):
+        if self.training:
+            halves = torch.split(x, x.shape[0] // 2, dim=0)
+            return torch.cat([mods[d](h) for d, h in enumerate(halves)], dim=0)
+        return mods[1](x)  # eval: target branch only
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = F.max_pool2d(F.relu(self._branch(self.w1, x) * self.g1 + self.b1), 2, 2)
+        x = self.conv2(x)
+        x = F.max_pool2d(F.relu(self._branch(self.w2, x) * self.g2 + self.b2), 2, 2)
+        x = x.reshape(x.shape[0], -1)
+        x = F.relu(self._branch(self.n3, self.fc3(x)) * self.g3 + self.b3)
+        x = F.relu(self._branch(self.n4, self.fc4(x)) * self.g4 + self.b4)
+        return self._branch(self.n5, self.fc5(x)) * self.g5 + self.b5
+
+
+def _t2n(t):
+    return t.detach().numpy().astype(np.float32)
+
+
+def _flax_variables_from_torch(tm, variables):
+    """Tie the flax model to the torch twin's weights (layouts converted)."""
+    params = jax.tree.map(lambda a: a, variables["params"])
+
+    def conv_kernel(w):  # OIHW -> HWIO
+        return jnp.asarray(_t2n(w).transpose(2, 3, 1, 0))
+
+    params["conv1"] = {
+        "kernel": conv_kernel(tm.conv1.weight),
+        "bias": jnp.asarray(_t2n(tm.conv1.bias)),
+    }
+    params["conv2"] = {
+        "kernel": conv_kernel(tm.conv2.weight),
+        "bias": jnp.asarray(_t2n(tm.conv2.bias)),
+    }
+    # fc3 consumes the flatten of [7,7,48] (NHWC) in flax but [48,7,7]
+    # (NCHW) in torch — permute the input-dim blocks accordingly.
+    w3 = _t2n(tm.fc3.weight).reshape(100, 48, 7, 7).transpose(0, 2, 3, 1)
+    params["fc3"] = {
+        "kernel": jnp.asarray(w3.reshape(100, 2352).T),
+        "bias": jnp.asarray(_t2n(tm.fc3.bias)),
+    }
+    for name, lin in (("fc4", tm.fc4), ("fc5", tm.fc5)):
+        params[name] = {
+            "kernel": jnp.asarray(_t2n(lin.weight).T),
+            "bias": jnp.asarray(_t2n(lin.bias)),
+        }
+    for i, (g, b) in enumerate(
+        [(tm.g1, tm.b1), (tm.g2, tm.b2), (tm.g3, tm.b3), (tm.g4, tm.b4), (tm.g5, tm.b5)],
+        start=1,
+    ):
+        params[f"dn{i}"] = {
+            "gamma": jnp.asarray(_t2n(g).reshape(-1)),
+            "beta": jnp.asarray(_t2n(b).reshape(-1)),
+        }
+    return {"params": params, "batch_stats": variables["batch_stats"]}
+
+
+# Function-scoped on purpose: a train-mode torch forward mutates running
+# buffers even under no_grad, so sharing one twin across tests would
+# desynchronize the stat comparison.
+@pytest.fixture()
+def tied_models():
+    torch.manual_seed(0)
+    tm = _TorchLeNetDWT(group_size=4).eval()
+    # Perturb affines so the shared gamma/beta path is actually exercised.
+    with torch.no_grad():
+        for g, b in [(tm.g1, tm.b1), (tm.g2, tm.b2), (tm.g3, tm.b3),
+                     (tm.g4, tm.b4), (tm.g5, tm.b5)]:
+            g.add_(0.1 * torch.randn_like(g))
+            b.add_(0.1 * torch.randn_like(b))
+    fm = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 6, 28, 28, 1)).astype(np.float32)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x), train=True)
+    variables = _flax_variables_from_torch(tm, variables)
+    return tm, fm, variables, x
+
+
+def _torch_input(x):
+    # [2, N, 28, 28, 1] NHWC domains -> concat halves NCHW.
+    flat = x.reshape(-1, 28, 28, 1).transpose(0, 3, 1, 2)
+    return torch.from_numpy(np.ascontiguousarray(flat))
+
+
+def test_train_forward_matches_torch(tied_models):
+    tm, fm, variables, x = tied_models
+    tm.train()
+    with torch.no_grad():
+        out_t = tm(_torch_input(x))
+    out_f, _ = fm.apply(
+        variables, jnp.asarray(x), train=True, mutable=["batch_stats"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_f).reshape(-1, 10), _t2n(out_t), rtol=1e-3, atol=2e-4
+    )
+
+
+def test_stat_updates_and_eval_match_torch(tied_models):
+    tm, fm, variables, x = tied_models
+    # Two train passes advance every branch's EMA on both sides...
+    tm.train()
+    with torch.no_grad():
+        tm(_torch_input(x))
+        tm(_torch_input(x))
+    vars_now = variables
+    for _ in range(2):
+        _, upd = fm.apply(
+            vars_now, jnp.asarray(x), train=True, mutable=["batch_stats"]
+        )
+        vars_now = {"params": vars_now["params"], **upd}
+
+    stats = vars_now["batch_stats"]
+    for i, wmod in ((1, tm.w1), (2, tm.w2)):
+        for d in range(2):
+            np.testing.assert_allclose(
+                np.asarray(stats[f"dn{i}"]["whitening"].mean[d]),
+                _t2n(wmod[d].running_mean).reshape(-1),
+                rtol=1e-4, atol=1e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(stats[f"dn{i}"]["whitening"].cov[d]),
+                _t2n(wmod[d].running_cov),
+                rtol=1e-4, atol=1e-5,
+            )
+    for i, nmod in ((3, tm.n3), (4, tm.n4), (5, tm.n5)):
+        for d in range(2):
+            np.testing.assert_allclose(
+                np.asarray(stats[f"dn{i}"]["bn"].mean[d]),
+                _t2n(nmod[d].running_mean),
+                rtol=1e-4, atol=1e-5,
+            )
+            np.testing.assert_allclose(
+                np.asarray(stats[f"dn{i}"]["bn"].var[d]),
+                _t2n(nmod[d].running_var),
+                rtol=1e-4, atol=1e-5,
+            )
+
+    # ...then eval (target-branch routing + running stats) must agree too.
+    tm.eval()
+    xe = x[1]  # a target-domain batch, [N, 28, 28, 1]
+    with torch.no_grad():
+        out_t = tm(torch.from_numpy(
+            np.ascontiguousarray(xe.transpose(0, 3, 1, 2))
+        ))
+    out_f = fm.apply(vars_now, jnp.asarray(xe), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_f), _t2n(out_t), rtol=1e-3, atol=2e-4
+    )

@@ -187,3 +187,83 @@ def test_jit_and_grad_compile():
     l, ns, g = step(jnp.asarray(x), stats)
     assert np.isfinite(float(l))
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+# ------------------------------------------------- degenerate inputs
+# SURVEY §5 NaN/PSD guard: the eps shrinkage (whitening.py:48 in the
+# reference) must keep the Cholesky factorization finite — in outputs AND
+# gradients — on inputs that make the raw covariance singular.
+
+
+def _grad_norm(x, stats, **kw):
+    def loss(x):
+        y, _ = group_whiten(x, stats, train=True, **kw)
+        return jnp.sum(y**2)
+
+    return jax.grad(loss)(x)
+
+
+def test_constant_input_stays_finite():
+    # Zero variance in every channel: raw cov is all-zeros; shrinkage makes
+    # it eps*I (PD), so outputs are exactly 0 and grads finite.
+    stats = init_whitening_stats(8, 4)
+    x = jnp.full((4, 5, 5, 8), 3.7, jnp.float32)
+    y, new_stats = group_whiten(x, stats, group_size=4, train=True)
+    # Rounding in the mean (~1e-7) is amplified by the ~1/sqrt(eps) (~32x)
+    # whitening matrix of the eps*I covariance — near-zero, not exactly 0.
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-3)
+    assert np.all(np.isfinite(np.asarray(new_stats.cov)))
+    g = _grad_norm(x, stats, group_size=4)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_zero_variance_channel_inside_group():
+    # One dead channel inside a group: raw cov is rank-deficient (PSD, not
+    # PD); shrinkage restores PD.
+    stats = init_whitening_stats(8, 4)
+    x = np.asarray(make_input(), np.float32)
+    x[..., 2] = -1.25  # constant channel 2 (group 0)
+    x = jnp.asarray(x)
+    y, _ = group_whiten(x, stats, group_size=4, train=True)
+    assert np.all(np.isfinite(np.asarray(y)))
+    g = _grad_norm(x, stats, group_size=4)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_duplicated_channels_rank_deficient_group():
+    # Perfectly correlated channels: another PSD-but-singular covariance.
+    stats = init_whitening_stats(8, 4)
+    x = np.asarray(make_input(), np.float32)
+    x[..., 1] = x[..., 0]
+    x[..., 3] = 2.0 * x[..., 0]
+    y, _ = group_whiten(jnp.asarray(x), stats, group_size=4, train=True)
+    assert np.all(np.isfinite(np.asarray(y)))
+    g = _grad_norm(jnp.asarray(x), stats, group_size=4)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_eval_on_fresh_all_ones_cov_stats():
+    # Fresh stats carry the reference's torch.ones([G,g,g]) covariance init
+    # (whitening.py:24): rank-1 PSD; eval-time shrinkage makes it PD. The
+    # smallest shrunk eigenvalue is ~eps so outputs are amplified by up to
+    # ~1/sqrt(eps) — large but finite is the reference-parity expectation.
+    stats = init_whitening_stats(8, 4)
+    x = jnp.asarray(make_input())
+    y, out_stats = group_whiten(x, stats, group_size=4, train=False)
+    assert np.all(np.isfinite(np.asarray(y)))
+    assert out_stats is stats  # eval never mutates state
+    assert float(jnp.max(jnp.abs(y))) < 10.0 / np.sqrt(EPS)
+
+
+def test_bf16_degenerate_input_finite():
+    # bf16 activations with a constant channel: stats are f32, outputs bf16.
+    stats = init_whitening_stats(8, 4)
+    x = np.asarray(make_input(), np.float32)
+    x[..., 5] = 0.0
+    xb = jnp.asarray(x, jnp.bfloat16)
+    y, new_stats = group_whiten(xb, stats, group_size=4, train=True)
+    assert y.dtype == jnp.bfloat16
+    assert new_stats.cov.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(y, dtype=np.float32)))
+    g = _grad_norm(xb, stats, group_size=4)
+    assert np.all(np.isfinite(np.asarray(g, dtype=np.float32)))
