@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--debug_nans", action="store_true",
+                   help="jax_debug_nans: fail fast at the op that produced a NaN "
+                        "(the whitening Cholesky guard, SURVEY \u00a75)")
     return p
 
 
@@ -52,6 +55,10 @@ def config_from_args(args: argparse.Namespace) -> DigitsConfig:
 
 def main(argv=None) -> float:
     args = build_parser().parse_args(argv)
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
     from dwt_tpu.train.loop import run_digits
 
     logger = MetricLogger(jsonl_path=args.metrics_jsonl)

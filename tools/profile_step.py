@@ -1,0 +1,131 @@
+"""Per-op profile of the flagship train step — the Pallas go/no-go data.
+
+SURVEY §7 step 1 ("measure first"): before hand-writing a Pallas kernel for
+the whitening chain (center → cov → cholesky → apply), measure how much of
+the step XLA already spends there.  Decision rule (PERF.md): build the
+fusion only if the whitening chain holds >10-15% of step time.
+
+Two measurement modes, printed as one JSON object:
+
+* ``cost``: XLA cost-analysis FLOPs of the full step vs an ablated step
+  with whitening sites replaced by BN sites (``--ablate``) — a
+  backend-independent upper bound on the whitening chain's FLOP share.
+* ``trace`` (``--trace DIR``): ``jax.profiler.trace`` around the timed
+  steps; inspect with TensorBoard/xprof or the trace-event JSON to
+  attribute wall time per fused op.
+
+Run on the real TPU (default platform) for the go/no-go numbers; runs on
+CPU too for plumbing checks.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(model_name: str, batch: int, image: int, group_size: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dwt_tpu.nn import ResNetDWT
+    from dwt_tpu.train import (
+        create_train_state,
+        make_officehome_train_step,
+        sgd_two_group,
+    )
+
+    rng = np.random.default_rng(0)
+    b = {
+        "source_x": jnp.asarray(
+            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 65, size=(batch,))),
+        "target_x": jnp.asarray(
+            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+        ),
+        "target_aug_x": jnp.asarray(
+            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+        ),
+    }
+    ctor = {
+        "resnet50": ResNetDWT.resnet50,
+        "resnet101": ResNetDWT.resnet101,
+        "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
+    }[model_name]
+    model = ctor(num_classes=65, group_size=group_size, dtype=jnp.bfloat16)
+    tx = sgd_two_group(1e-2, 1e-3)
+    sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    step = jax.jit(make_officehome_train_step(model, tx, 0.1), donate_argnums=0)
+    return step, state, b
+
+
+def flops_of(step, state, batch):
+    compiled = step.lower(state, batch).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0]
+    return compiled, float(analysis.get("flops", 0.0)), analysis
+
+
+def main():
+    import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "resnet101", "tiny"])
+    ap.add_argument("--batch", type=int, default=18)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--group_size", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--trace", default=None,
+                    help="directory for a jax.profiler trace of the timed loop")
+    args = ap.parse_args()
+
+    out = {
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": args.model,
+        "batch_per_stream": args.batch,
+        "image": args.image,
+    }
+
+    step, state, b = build_step(args.model, args.batch, args.image,
+                                args.group_size)
+    compiled, total_flops, _ = flops_of(step, state, b)
+    out["flops_per_step"] = total_flops
+
+    # Warmup, then timed loop (optionally traced).
+    state, m = compiled(state, b)
+    jax.block_until_ready(m)
+    state, m = compiled(state, b)
+    jax.block_until_ready(m)
+
+    def timed():
+        nonlocal state, m
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = compiled(state, b)
+        jax.block_until_ready(m)
+        return time.perf_counter() - t0
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            dt = timed()
+        out["trace_dir"] = args.trace
+    else:
+        dt = timed()
+
+    out["step_time_ms"] = round(dt / args.steps * 1e3, 3)
+    out["imgs_per_sec"] = round(3 * args.batch * args.steps / dt, 2)
+    out["achieved_flops_per_sec"] = total_flops / (dt / args.steps)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
