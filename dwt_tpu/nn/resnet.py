@@ -122,6 +122,10 @@ class ResNetDWT(fnn.Module):
     momentum: float = 0.1
     axis_name: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
+    # False → every norm site (incl. stem) is a DomainBatchNorm: the
+    # whitening-ablated twin used by tools/profile_step.py --ablate to
+    # isolate the whitening chain's cost (PERF.md go/no-go).
+    whiten: bool = True
 
     @classmethod
     def resnet50(cls, **kw) -> "ResNetDWT":
@@ -156,17 +160,18 @@ class ResNetDWT(fnn.Module):
             kernel_init=_conv_init,
             name="conv1",
         )(x)
+        stem_kw = dict(
+            num_domains=self.num_domains,
+            eval_domain=self.eval_domain,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+            name="dn1",
+        )
         x = apply_domain_norm(
             x,
-            DomainWhiten(
-                64,
-                self.group_size,
-                num_domains=self.num_domains,
-                eval_domain=self.eval_domain,
-                momentum=self.momentum,
-                axis_name=self.axis_name,
-                name="dn1",
-            ),
+            DomainWhiten(64, self.group_size, **stem_kw)
+            if self.whiten
+            else DomainBatchNorm(64, **stem_kw),
             train,
             self.num_domains,
         )
@@ -182,7 +187,7 @@ class ResNetDWT(fnn.Module):
                     stride=stride,
                     # Stage 1 whitens; deeper stages batch-normalize
                     # (resnet50…py:73-105 layer==1 dispatch).
-                    use_whitening=(stage == 1),
+                    use_whitening=(stage == 1 and self.whiten),
                     has_downsample=(block == 0),
                     group_size=self.group_size,
                     num_domains=self.num_domains,

@@ -118,17 +118,29 @@ def whitening_matrix(cov_shrunk: jax.Array) -> jax.Array:
     return solve_triangular(chol, eye, lower=True)
 
 
-def apply_whitening(xn: jax.Array, w: jax.Array) -> jax.Array:
+def apply_whitening(
+    xn: jax.Array, w: jax.Array, compute_dtype=None
+) -> jax.Array:
     """Apply per-group whitening matrix ``w [G, g, g]`` to centered ``xn``.
 
     One batched matmul over groups — XLA maps it straight onto the MXU; it is
     mathematically the reference's grouped 1x1 conv (``whitening.py:55``).
+
+    ``compute_dtype`` sets the matmul operand dtype (default: ``w.dtype``,
+    i.e. f32).  bf16 nets pass bf16 so the apply rides the full-rate bf16
+    MXU path with half the operand traffic; accumulation stays f32 via
+    ``preferred_element_type``.
     """
+    compute_dtype = compute_dtype or w.dtype
+    acc_dtype = jnp.promote_types(compute_dtype, jnp.float32)
     shape = xn.shape
     num_groups, group_size = w.shape[0], w.shape[1]
     t = xn.reshape(-1, num_groups, group_size)
     y = jnp.einsum(
-        "mgc,gdc->mgd", t.astype(w.dtype), w, preferred_element_type=w.dtype
+        "mgc,gdc->mgd",
+        t.astype(compute_dtype),
+        w.astype(compute_dtype),
+        preferred_element_type=acc_dtype,
     )
     return y.reshape(shape).astype(xn.dtype)
 
@@ -172,7 +184,10 @@ def group_whiten(
         xn = xf - m
         cov = group_cov(xn, num_groups, group_size, axis_name)
         w = whitening_matrix(_shrink(cov, eps))
-        y = apply_whitening(xn, w).astype(x.dtype)
+        # Moments/factorization stay f32; the apply matmul runs in the
+        # activation dtype (bf16 nets → bf16 MXU path, f32 accumulation) —
+        # the standard mixed-precision norm recipe.
+        y = apply_whitening(xn, w, compute_dtype=x.dtype).astype(x.dtype)
         new_stats = WhiteningStats(
             mean=(
                 momentum * lax.stop_gradient(m)
@@ -187,5 +202,5 @@ def group_whiten(
     else:
         xn = xf - stats.mean
         w = whitening_matrix(_shrink(stats.cov.astype(xf.dtype), eps))
-        y = apply_whitening(xn, w).astype(x.dtype)
+        y = apply_whitening(xn, w, compute_dtype=x.dtype).astype(x.dtype)
         return y, stats

@@ -113,3 +113,18 @@ def test_train_rejects_wrong_domain_count(tiny_setup):
     model, x, variables = tiny_setup
     with pytest.raises(ValueError, match="domain"):
         model.apply(variables, x[:2], train=True, mutable=["batch_stats"])
+
+
+def test_whiten_false_ablates_all_whitening_sites():
+    # The --ablate twin (tools/profile_step.py): every norm site is BN.
+    model = tiny_resnet(whiten=False)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(3, 2, 32, 32, 3)), jnp.float32
+    )
+    variables = model.init(jax.random.key(0), x, train=True)
+    leaves = jax.tree_util.tree_flatten_with_path(variables["batch_stats"])[0]
+    paths = {jax.tree_util.keystr(p) for p, _ in leaves}
+    assert not any("whitening" in p for p in paths)
+    assert any("bn" in p for p in paths)
+    logits, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    assert logits.shape == (3, 2, 7)

@@ -27,7 +27,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_step(model_name: str, batch: int, image: int, group_size: int):
+def build_step(model_name: str, batch: int, image: int, group_size: int,
+               whiten: bool = True):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -57,7 +58,8 @@ def build_step(model_name: str, batch: int, image: int, group_size: int):
         "resnet101": ResNetDWT.resnet101,
         "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
     }[model_name]
-    model = ctor(num_classes=65, group_size=group_size, dtype=jnp.bfloat16)
+    model = ctor(num_classes=65, group_size=group_size, dtype=jnp.bfloat16,
+                 whiten=whiten)
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
@@ -85,6 +87,10 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--trace", default=None,
                     help="directory for a jax.profiler trace of the timed loop")
+    ap.add_argument("--ablate", action="store_true",
+                    help="also build + time the whitening-ablated twin "
+                         "(every norm site a BN) and report the whitening "
+                         "chain's share of FLOPs and step time")
     args = ap.parse_args()
 
     out = {
@@ -124,6 +130,26 @@ def main():
     out["step_time_ms"] = round(dt / args.steps * 1e3, 3)
     out["imgs_per_sec"] = round(3 * args.batch * args.steps / dt, 2)
     out["achieved_flops_per_sec"] = total_flops / (dt / args.steps)
+
+    if args.ablate:
+        astep, astate, ab = build_step(
+            args.model, args.batch, args.image, args.group_size, whiten=False
+        )
+        acompiled, aflops, _ = flops_of(astep, astate, ab)
+        astate, am = acompiled(astate, ab)
+        jax.block_until_ready(am)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            astate, am = acompiled(astate, ab)
+        jax.block_until_ready(am)
+        adt = time.perf_counter() - t0
+        out["ablated_flops_per_step"] = aflops
+        out["ablated_step_time_ms"] = round(adt / args.steps * 1e3, 3)
+        if total_flops and aflops:
+            out["whitening_flops_share"] = round(
+                (total_flops - aflops) / total_flops, 4
+            )
+        out["whitening_time_share"] = round((dt - adt) / dt, 4)
     print(json.dumps(out))
 
 
