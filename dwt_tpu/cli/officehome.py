@@ -73,8 +73,9 @@ def config_from_args(args: argparse.Namespace) -> OfficeHomeConfig:
     return OfficeHomeConfig(**kwargs)
 
 
-def main(argv=None) -> float:
-    args = build_parser().parse_args(argv)
+def run_from_args(args: argparse.Namespace) -> float:
+    """Shared entrypoint plumbing for the OfficeHome-recipe CLIs (this one
+    and ``dwt_tpu.cli.visda``): debug toggles, logger lifecycle, dispatch."""
     if args.debug_nans:
         import jax
 
@@ -86,6 +87,10 @@ def main(argv=None) -> float:
         return run_officehome(config_from_args(args), logger)
     finally:
         logger.close()
+
+
+def main(argv=None) -> float:
+    return run_from_args(build_parser().parse_args(argv))
 
 
 if __name__ == "__main__":

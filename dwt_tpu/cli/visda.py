@@ -1,0 +1,40 @@
+"""VisDA-2017 entrypoint — BASELINE.json configs[4] (ResNet101-DWT).
+
+The reference repo has no VisDA script (its entrypoints are digits and
+OfficeHome only — SURVEY §0 file inventory); BASELINE.json names the
+VisDA-2017 synthetic→real config as a target of the TPU build.  This CLI is
+the OfficeHome machinery (``resnet50_dwt_mec_officehome.py:495-600`` recipe:
+triple-stream MEC training, 10-pass stat collection) re-parameterized with
+the VisDA constants: 12 classes, ResNet101 backbone, train/validation
+ImageFolder splits.  All OfficeHome flags remain available for overrides.
+"""
+
+from __future__ import annotations
+
+from dwt_tpu.cli import officehome as _oh
+
+_VISDA_DEFAULTS = {
+    "arch": "resnet101",
+    "num_classes": 12,
+    "s_dset_path": "../data/visda-2017/train",
+    "t_dset_path": "../data/visda-2017/validation",
+    # No checkpoint by default: the OfficeHome default is a ResNet50
+    # state_dict whose keys would silently partial-load into ResNet101
+    # (strict=False semantics); pass an explicit ResNet101 checkpoint.
+    "resnet_path": "",
+}
+
+
+def build_parser():
+    p = _oh.build_parser()
+    p.description = "dwt_tpu DWT-MEC VisDA-2017 trainer (ResNet101-DWT)"
+    p.set_defaults(**_VISDA_DEFAULTS)
+    return p
+
+
+def main(argv=None) -> float:
+    return _oh.run_from_args(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

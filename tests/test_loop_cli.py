@@ -121,3 +121,26 @@ def test_officehome_cli_synthetic(tmp_path):
     lines = open(tmp_path / "oh.jsonl").read().strip().splitlines()
     kinds = {__import__("json").loads(l)["kind"] for l in lines}
     assert {"train", "test", "stat_collection", "final_test"} <= kinds
+
+
+def test_visda_cli_defaults_and_smoke(tmp_path):
+    from dwt_tpu.cli.visda import build_parser, main
+
+    args = build_parser().parse_args([])
+    assert args.arch == "resnet101" and args.num_classes == 12
+
+    acc = main(
+        [
+            "--synthetic",
+            "--synthetic_size", "12",
+            "--arch", "tiny",  # keep the smoke cheap; default is resnet101
+            "--img_crop_size", "32",
+            "--source_batch_size", "6",
+            "--test_batch_size", "6",
+            "--num_iters", "2",
+            "--check_acc_step", "2",
+            "--stat_collection_passes", "1",
+            "--group_size", "4",
+        ]
+    )
+    assert 0.0 <= acc <= 100.0
