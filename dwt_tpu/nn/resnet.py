@@ -86,8 +86,12 @@ class BottleneckDWT(fnn.Module):
         h = conv(self.planes, (1, 1), name="conv1")(x)
         h = fnn.relu(norm(h, self.planes, "dn1"))
 
+        # Explicit symmetric padding, NOT "SAME": with stride 2, SAME pads
+        # (0,1) while the reference's torch ``padding=1`` pads (1,1) — a
+        # different spatial sampling that would break converted-checkpoint
+        # parity (torch-twin test pinpointed this).
         h = conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                 padding="SAME", name="conv2")(h)
+                 padding=((1, 1), (1, 1)), name="conv2")(h)
         h = fnn.relu(norm(h, self.planes, "dn2"))
 
         h = conv(out_ch, (1, 1), name="conv3")(h)

@@ -246,3 +246,126 @@ def test_stat_updates_and_eval_match_torch(tied_models):
     np.testing.assert_allclose(
         np.asarray(out_f), _t2n(out_t), rtol=1e-3, atol=2e-4
     )
+
+
+# ------------------------------------------------ ResNet Bottleneck parity
+# Torch twin of the reference's triple-branch Bottleneck
+# (resnet50_dwt_mec_officehome.py:66-262): thirds split at every norm site,
+# shared affine after the branch concat, whitening branches for layer-1
+# style blocks, BN branches otherwise, downsample norm site on block 0.
+
+
+class _TorchBottleneck(nn.Module):
+    def __init__(self, cin, planes, stride=1, whiten=True, downsample=False,
+                 group_size=4):
+        super().__init__()
+        out_ch = planes * 4
+
+        def norms(c):
+            if whiten:
+                return nn.ModuleList(
+                    [_TorchWhiten(c, group_size) for _ in range(3)]
+                )
+            return nn.ModuleList(
+                [nn.BatchNorm2d(c, affine=False) for _ in range(3)]
+            )
+
+        self.conv1 = nn.Conv2d(cin, planes, 1, bias=False)
+        self.n1, self.g1 = norms(planes), nn.Parameter(torch.randn(1, planes, 1, 1) * 0.1 + 1)
+        self.b1 = nn.Parameter(torch.randn(1, planes, 1, 1) * 0.1)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride, padding=1,
+                               bias=False)
+        self.n2, self.g2 = norms(planes), nn.Parameter(torch.randn(1, planes, 1, 1) * 0.1 + 1)
+        self.b2 = nn.Parameter(torch.randn(1, planes, 1, 1) * 0.1)
+        self.conv3 = nn.Conv2d(planes, out_ch, 1, bias=False)
+        self.n3, self.g3 = norms(out_ch), nn.Parameter(torch.randn(1, out_ch, 1, 1) * 0.1 + 1)
+        self.b3 = nn.Parameter(torch.randn(1, out_ch, 1, 1) * 0.1)
+        self.has_ds = downsample
+        if downsample:
+            self.ds_conv = nn.Conv2d(cin, out_ch, 1, stride=stride, bias=False)
+            self.nd = norms(out_ch)
+            self.gd = nn.Parameter(torch.randn(1, out_ch, 1, 1) * 0.1 + 1)
+            self.bd = nn.Parameter(torch.randn(1, out_ch, 1, 1) * 0.1)
+
+    def _branch(self, mods, x):
+        if self.training:
+            thirds = torch.split(x, x.shape[0] // 3, dim=0)
+            return torch.cat([mods[d](t) for d, t in enumerate(thirds)], dim=0)
+        return mods[1](x)
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self._branch(self.n1, self.conv1(x)) * self.g1 + self.b1)
+        out = F.relu(self._branch(self.n2, self.conv2(out)) * self.g2 + self.b2)
+        out = self._branch(self.n3, self.conv3(out)) * self.g3 + self.b3
+        if self.has_ds:
+            identity = (
+                self._branch(self.nd, self.ds_conv(x)) * self.gd + self.bd
+            )
+        return F.relu(out + identity)
+
+
+def _tie_bottleneck(tm, variables):
+    params = dict(variables["params"])
+
+    def conv(w):
+        return jnp.asarray(_t2n(w).transpose(2, 3, 1, 0))
+
+    params["conv1"] = {"kernel": conv(tm.conv1.weight)}
+    params["conv2"] = {"kernel": conv(tm.conv2.weight)}
+    params["conv3"] = {"kernel": conv(tm.conv3.weight)}
+    sites = [("dn1", tm.g1, tm.b1), ("dn2", tm.g2, tm.b2), ("dn3", tm.g3, tm.b3)]
+    if tm.has_ds:
+        params["downsample_conv"] = {"kernel": conv(tm.ds_conv.weight)}
+        sites.append(("downsample_dn", tm.gd, tm.bd))
+    for name, g, b in sites:
+        params[name] = {
+            "gamma": jnp.asarray(_t2n(g).reshape(-1)),
+            "beta": jnp.asarray(_t2n(b).reshape(-1)),
+        }
+    return {"params": params, "batch_stats": variables["batch_stats"]}
+
+
+@pytest.mark.parametrize("whiten,stride", [(True, 2), (False, 1)])
+def test_bottleneck_matches_torch(whiten, stride):
+    from dwt_tpu.nn.resnet import BottleneckDWT
+
+    torch.manual_seed(1)
+    cin, planes, n, hw = 16, 8, 3, 8
+    tm = _TorchBottleneck(cin, planes, stride=stride, whiten=whiten,
+                          downsample=True, group_size=4)
+    fm = BottleneckDWT(planes=planes, stride=stride, use_whitening=whiten,
+                       has_downsample=True, group_size=4)
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3, n, hw, hw, cin)).astype(np.float32)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x), train=True)
+    variables = _tie_bottleneck(tm, variables)
+
+    def torch_in(a):
+        flat = a.reshape(-1, hw, hw, cin).transpose(0, 3, 1, 2)
+        return torch.from_numpy(np.ascontiguousarray(flat))
+
+    # Train forward parity + stat advance.
+    tm.train()
+    with torch.no_grad():
+        out_t = tm(torch_in(x))
+    out_f, upd = fm.apply(
+        variables, jnp.asarray(x), train=True, mutable=["batch_stats"]
+    )
+    got = np.asarray(out_f)          # [3, n, h', w', C]
+    want = _t2n(out_t)               # [3n, C, h', w']
+    want = want.reshape(3, n, *want.shape[1:]).transpose(0, 1, 3, 4, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+    # Eval forward parity on the advanced running stats (target branch).
+    tm.eval()
+    vars_now = {"params": variables["params"], **upd}
+    xe = x[1]
+    with torch.no_grad():
+        out_t = tm(torch.from_numpy(
+            np.ascontiguousarray(xe.transpose(0, 3, 1, 2))
+        ))
+    out_f = fm.apply(vars_now, jnp.asarray(xe), train=False)
+    want = _t2n(out_t).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(out_f), want, rtol=1e-3, atol=2e-4)
