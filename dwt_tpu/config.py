@@ -84,3 +84,4 @@ class OfficeHomeConfig:
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
     bf16: bool = False
+    remat: bool = False  # jax.checkpoint per bottleneck (HBM for FLOPs)

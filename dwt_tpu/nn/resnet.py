@@ -130,6 +130,10 @@ class ResNetDWT(fnn.Module):
     # whitening-ablated twin used by tools/profile_step.py --ablate to
     # isolate the whitening chain's cost (PERF.md go/no-go).
     whiten: bool = True
+    # Rematerialize each bottleneck block in the backward pass
+    # (jax.checkpoint): trades ~1/3 more FLOPs for not storing block
+    # activations — the standard HBM lever for larger per-chip batches.
+    remat: bool = False
 
     @classmethod
     def resnet50(cls, **kw) -> "ResNetDWT":
@@ -182,11 +186,16 @@ class ResNetDWT(fnn.Module):
         x = fnn.relu(x)
         x = fnn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
+        block_cls = (
+            fnn.remat(BottleneckDWT, static_argnums=(2,))
+            if self.remat
+            else BottleneckDWT
+        )
         for stage, num_blocks in enumerate(self.stage_sizes, start=1):
             planes = 64 * 2 ** (stage - 1)
             for block in range(num_blocks):
                 stride = 2 if (stage > 1 and block == 0) else 1
-                x = BottleneckDWT(
+                x = block_cls(
                     planes=planes,
                     stride=stride,
                     # Stage 1 whitens; deeper stages batch-normalize

@@ -425,6 +425,7 @@ def run_officehome(
             momentum=cfg.running_momentum,
             axis_name=axis_name,
             dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+            remat=cfg.remat,
         )
 
     model, wrap, wrap_batch = _maybe_dp(cfg, build_model, {})

@@ -128,3 +128,29 @@ def test_whiten_false_ablates_all_whitening_sites():
     assert any("bn" in p for p in paths)
     logits, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
     assert logits.shape == (3, 2, 7)
+
+
+def test_remat_preserves_numerics():
+    # jax.checkpoint must change memory, not math: same params, same batch,
+    # same outputs and gradients (up to recompute float noise).
+    x = jnp.asarray(
+        np.random.default_rng(2).normal(size=(3, 2, 32, 32, 3)), jnp.float32
+    )
+    base = tiny_resnet()
+    rem = tiny_resnet(remat=True)
+    variables = base.init(jax.random.key(0), x, train=True)
+
+    def loss(model, params):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return jnp.sum(out**2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(variables["params"])
+    l1, g1 = jax.value_and_grad(lambda p: loss(rem, p))(variables["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )

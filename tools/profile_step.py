@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_step(model_name: str, batch: int, image: int, group_size: int,
-               whiten: bool = True):
+               whiten: bool = True, remat: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -59,7 +59,7 @@ def build_step(model_name: str, batch: int, image: int, group_size: int,
         "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
     }[model_name]
     model = ctor(num_classes=65, group_size=group_size, dtype=jnp.bfloat16,
-                 whiten=whiten)
+                 whiten=whiten, remat=remat)
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
@@ -91,6 +91,10 @@ def main():
                     help="also build + time the whitening-ablated twin "
                          "(every norm site a BN) and report the whitening "
                          "chain's share of FLOPs and step time")
+    ap.add_argument("--remat", action="store_true",
+                    help="profile the rematerialized (jax.checkpoint) "
+                         "variant — measures the HBM-for-FLOPs tradeoff "
+                         "behind the training CLIs' --remat flag")
     args = ap.parse_args()
 
     out = {
@@ -102,7 +106,8 @@ def main():
     }
 
     step, state, b = build_step(args.model, args.batch, args.image,
-                                args.group_size)
+                                args.group_size, remat=args.remat)
+    out["remat"] = args.remat
     compiled, total_flops, _ = flops_of(step, state, b)
     out["flops_per_step"] = total_flops
 
