@@ -144,3 +144,28 @@ def test_visda_cli_defaults_and_smoke(tmp_path):
         ]
     )
     assert 0.0 <= acc <= 100.0
+
+
+@pytest.mark.slow
+def test_officehome_loop_data_parallel():
+    """ResNet-path DP smoke on the 8-device mesh: axis-free init + sharded
+    step + divisible batch (6 streams x 8 devices would fail; 8 works)."""
+    from dwt_tpu.cli.officehome import main
+
+    acc = main(
+        [
+            "--synthetic",
+            "--synthetic_size", "16",
+            "--arch", "tiny",
+            "--img_crop_size", "32",
+            "--num_classes", "5",
+            "--source_batch_size", "8",
+            "--test_batch_size", "8",
+            "--num_iters", "2",
+            "--check_acc_step", "2",
+            "--stat_collection_passes", "1",
+            "--group_size", "4",
+            "--data_parallel",
+        ]
+    )
+    assert 0.0 <= acc <= 100.0

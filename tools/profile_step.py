@@ -137,8 +137,11 @@ def main():
     out["achieved_flops_per_sec"] = total_flops / (dt / args.steps)
 
     if args.ablate:
+        # Same remat setting as the main step — otherwise the recompute
+        # overhead would be misattributed to the whitening chain.
         astep, astate, ab = build_step(
-            args.model, args.batch, args.image, args.group_size, whiten=False
+            args.model, args.batch, args.image, args.group_size,
+            whiten=False, remat=args.remat,
         )
         acompiled, aflops, _ = flops_of(astep, astate, ab)
         astate, am = acompiled(astate, ab)
