@@ -1,0 +1,96 @@
+"""OfficeHome full 12-pair sweep — BASELINE.json configs[3] (paper Table 3).
+
+The reference has no sweep driver (each of the 12 source→target pairs is a
+separate ``resnet50_dwt_mec_officehome.py`` invocation); this CLI runs all
+ordered domain pairs with the same recipe and reports the per-pair target
+top-1 plus the Table-3-style mean.
+
+Usage::
+
+    python -m dwt_tpu.cli.officehome_sweep \
+        --dataset_root .../OfficeHomeDataset_10072016 \
+        --resnet_path .../model_best_gr_4.pth.tar \
+        --results_json sweep.json
+
+Any OfficeHome flag applies to every pair (``--num_iters``, ``--remat``,
+``--data_parallel``, ...).  ``--synthetic`` sweeps generated data — a
+no-dataset smoke of the whole matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+
+from dwt_tpu.cli import officehome as _oh
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = _oh.build_parser()
+    p.description = "dwt_tpu DWT-MEC OfficeHome 12-pair sweep"
+    p.add_argument("--dataset_root", type=str, default=None,
+                   help="OfficeHomeDataset root containing the domain dirs")
+    p.add_argument("--domains", type=str,
+                   default="Art,Clipart,Product,RealWorld",
+                   help="comma-separated domain dir names")
+    p.add_argument("--pairs", type=str, default=None,
+                   help='subset like "Art:Clipart,Product:Art" '
+                        "(default: all ordered pairs)")
+    p.add_argument("--results_json", type=str, default=None)
+    return p
+
+
+def _pairs(args):
+    domains = [d.strip() for d in args.domains.split(",") if d.strip()]
+    if args.pairs:
+        pairs = []
+        for item in args.pairs.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if ":" not in item:
+                raise SystemExit(
+                    f'--pairs entries must be "Source:Target"; got {item!r}'
+                )
+            s, t = item.split(":", 1)
+            pairs.append((s.strip(), t.strip()))
+        return pairs
+    return [(s, t) for s, t in itertools.permutations(domains, 2)]
+
+
+def main(argv=None) -> float:
+    args = build_parser().parse_args(argv)
+    if not args.synthetic and not args.dataset_root:
+        raise SystemExit("--dataset_root is required unless --synthetic")
+
+    results = {}
+    base_ckpt = args.ckpt_dir
+    base_jsonl = args.metrics_jsonl
+    for source, target in _pairs(args):
+        tag = f"{source}2{target}"
+        if args.dataset_root:
+            args.s_dset_path = os.path.join(args.dataset_root, source)
+            args.t_dset_path = os.path.join(args.dataset_root, target)
+        if base_ckpt:
+            args.ckpt_dir = os.path.join(base_ckpt, tag)
+        if base_jsonl:
+            # One metrics file per pair — records from different pairs are
+            # otherwise indistinguishable (step counters restart per pair).
+            root, ext = os.path.splitext(base_jsonl)
+            args.metrics_jsonl = f"{root}.{tag}{ext or '.jsonl'}"
+        acc = _oh.run_from_args(args)
+        results[f"{source}->{target}"] = acc
+        print(f"[sweep] {source}->{target}: {acc:.2f}")
+
+    mean = sum(results.values()) / max(len(results), 1)
+    print(f"[sweep] mean over {len(results)} pairs: {mean:.2f}")
+    if args.results_json:
+        with open(args.results_json, "w") as f:
+            json.dump({"pairs": results, "mean": mean}, f, indent=2)
+    return mean
+
+
+if __name__ == "__main__":
+    main()

@@ -18,11 +18,26 @@ def _root(ckpt_dir: str) -> str:
     return os.path.abspath(os.path.expanduser(ckpt_dir))
 
 
-def save_state(ckpt_dir: str, step: int, state: Any) -> str:
-    """Write ``state`` under ``ckpt_dir/<step>``; returns the path."""
+def save_state(
+    ckpt_dir: str, step: int, state: Any, keep: Optional[int] = None
+) -> str:
+    """Write ``state`` under ``ckpt_dir/<step>``; returns the path.
+
+    Overwrites an existing same-step checkpoint (``force=True``) so
+    crash-resume re-saves are idempotent instead of raising.  ``keep=N``
+    prunes to the newest ``N`` steps after saving (``keep=1`` is the
+    reference's single-artifact "model_best" convention).
+    """
     path = os.path.join(_root(ckpt_dir), str(int(step)))
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state)
+        ckptr.save(path, state, force=True)
+    if keep is not None:
+        import shutil
+
+        root = _root(ckpt_dir)
+        steps = sorted(int(d) for d in os.listdir(root) if d.isdigit())
+        for old in steps[:-keep]:
+            shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
     return path
 
 

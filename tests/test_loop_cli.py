@@ -169,3 +169,45 @@ def test_officehome_loop_data_parallel():
         ]
     )
     assert 0.0 <= acc <= 100.0
+
+
+def test_officehome_best_checkpoint_saved(tmp_path):
+    from dwt_tpu.cli.officehome import main
+
+    ckpt = str(tmp_path / "oh_ck")
+    main(
+        [
+            "--synthetic",
+            "--synthetic_size", "12",
+            "--arch", "tiny",
+            "--img_crop_size", "32",
+            "--num_classes", "5",
+            "--source_batch_size", "6",
+            "--test_batch_size", "6",
+            "--num_iters", "2",
+            "--check_acc_step", "2",
+            "--stat_collection_passes", "0",
+            "--group_size", "4",
+            "--ckpt_dir", ckpt,
+        ]
+    )
+    # The reference's model_best convention: highest-accuracy state kept
+    # in a dedicated subdir.
+    assert latest_step(os.path.join(ckpt, "best_gr_4")) is not None
+
+
+def test_checkpoint_resave_and_keep(tmp_path):
+    from dwt_tpu.utils import save_state
+
+    model = LeNetDWT(group_size=4)
+    tx = adam_l2(1e-3)
+    sample = jnp.zeros((2, 4, 28, 28, 1), jnp.float32)
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    ck = str(tmp_path / "ck")
+    # Re-saving the same step must overwrite, not raise (crash-resume).
+    save_state(ck, 5, state)
+    save_state(ck, 5, state)
+    # keep=1 prunes to a single artifact (the model_best convention).
+    save_state(ck, 7, state, keep=1)
+    assert latest_step(ck) == 7
+    assert sorted(os.listdir(ck)) == ["7"]
