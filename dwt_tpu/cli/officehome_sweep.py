@@ -65,10 +65,25 @@ def main(argv=None) -> float:
     if not args.synthetic and not args.dataset_root:
         raise SystemExit("--dataset_root is required unless --synthetic")
 
+    pairs = _pairs(args)
+    if len(set(pairs)) != len(pairs):
+        raise SystemExit(f"--pairs contains duplicates: {pairs}")
+    if args.dataset_root:
+        # Fail fast on typo'd domain names before any pair trains.
+        missing = [
+            d for pair in pairs for d in pair
+            if not os.path.isdir(os.path.join(args.dataset_root, d))
+        ]
+        if missing:
+            raise SystemExit(
+                f"domain dirs not found under {args.dataset_root}: "
+                f"{sorted(set(missing))}"
+            )
+
     results = {}
     base_ckpt = args.ckpt_dir
     base_jsonl = args.metrics_jsonl
-    for source, target in _pairs(args):
+    for source, target in pairs:
         tag = f"{source}2{target}"
         if args.dataset_root:
             args.s_dset_path = os.path.join(args.dataset_root, source)
@@ -83,12 +98,22 @@ def main(argv=None) -> float:
         acc = _oh.run_from_args(args)
         results[f"{source}->{target}"] = acc
         print(f"[sweep] {source}->{target}: {acc:.2f}")
+        if args.results_json:
+            # Written after EVERY pair so a crash keeps completed results.
+            with open(args.results_json, "w") as f:
+                json.dump(
+                    {
+                        "pairs": results,
+                        "mean": sum(results.values()) / len(results),
+                        "completed": len(results),
+                        "total": len(pairs),
+                    },
+                    f,
+                    indent=2,
+                )
 
     mean = sum(results.values()) / max(len(results), 1)
     print(f"[sweep] mean over {len(results)} pairs: {mean:.2f}")
-    if args.results_json:
-        with open(args.results_json, "w") as f:
-            json.dump({"pairs": results, "mean": mean}, f, indent=2)
     return mean
 
 

@@ -46,6 +46,21 @@ def init_batch_norm_stats(num_features: int, dtype=jnp.float32) -> BatchNormStat
     )
 
 
+def _normalize(x, xf, m, var, eps):
+    """``(x - m) * rsqrt(var + eps)`` with f32 statistics.
+
+    f32 activations use the exact centered form.  Lower-precision
+    activations (bf16) get the scale/bias folding ``x*s + (-m*s)`` applied
+    in the activation dtype — per-channel f32 scalars, bf16 elementwise, the
+    same recipe Flax's own BatchNorm uses — so the elementwise chain stays
+    half-width instead of materializing an f32 copy of the activation.
+    """
+    scale = lax.rsqrt(var + eps)
+    if x.dtype == xf.dtype:
+        return (xf - m) * scale
+    return x * scale.astype(x.dtype) + (-(m * scale)).astype(x.dtype)
+
+
 def batch_norm(
     x: jax.Array,
     stats: BatchNormStats,
@@ -73,7 +88,7 @@ def batch_norm(
             msq = lax.pmean(msq, axis_name)
             n = n * lax.psum(1, axis_name)
         var = msq - jnp.square(m)  # biased — used for normalization
-        y = (xf - m) * lax.rsqrt(var + eps)
+        y = _normalize(x, xf, m, var, eps)
 
         count = stats.count + 1
         if momentum is None:
@@ -94,5 +109,5 @@ def batch_norm(
         )
         return y.astype(x.dtype), new_stats
     else:
-        y = (xf - stats.mean) * lax.rsqrt(stats.var + eps)
+        y = _normalize(x, xf, stats.mean, stats.var, eps)
         return y.astype(x.dtype), stats

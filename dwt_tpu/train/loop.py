@@ -162,6 +162,33 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
     return model, wrap, lambda b: shard_batch(b, mesh)
 
 
+def _best_record_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, "best.json")
+
+
+def _write_best_record(ckpt_dir: str, accuracy: float, step: int) -> None:
+    """Persist the best accuracy so crash-resume cannot regress the
+    "model_best" artifact (a resumed run re-seeds ``best_acc`` from this
+    instead of -1.0 and overwriting a better pre-crash checkpoint)."""
+    import json
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with open(_best_record_path(ckpt_dir), "w") as f:
+        json.dump({"accuracy": accuracy, "step": step}, f)
+
+
+def _read_best_record(ckpt_dir: Optional[str]) -> float:
+    import json
+
+    if not ckpt_dir or not os.path.exists(_best_record_path(ckpt_dir)):
+        return -1.0
+    try:
+        with open(_best_record_path(ckpt_dir)) as f:
+            return float(json.load(f)["accuracy"])
+    except (ValueError, KeyError, OSError):
+        return -1.0
+
+
 def _evaluate(eval_step, state: TrainState, dataset, batch_size: int) -> dict:
     """Accumulate eval counters; multi-host runs shard the test set per
     process and sum the counters across processes (the cross-replica sum
@@ -503,7 +530,7 @@ def run_officehome(
         train_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
     )
     acc = 0.0
-    best_acc = -1.0
+    best_acc = _read_best_record(cfg.ckpt_dir)
     for it, batch in enumerate(batches, start=start_iter):
         state, metrics = train_step(state, batch)
         if it % cfg.log_interval == 0:
@@ -529,6 +556,7 @@ def run_officehome(
                     state,
                     keep=1,
                 )
+                _write_best_record(cfg.ckpt_dir, acc, int(state.step))
                 logger.log("best", int(state.step), accuracy=acc)
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
             save_state(cfg.ckpt_dir, int(state.step), state)

@@ -84,3 +84,20 @@ def test_cumulative_mode():
         bn(torch.tensor(x))
     np.testing.assert_allclose(np.asarray(stats.mean), bn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(stats.var), bn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_activations_use_folded_halfwidth_path():
+    rng = np.random.default_rng(5)
+    x32 = rng.normal(size=(16, 6, 6, 8)).astype(np.float32)
+    stats = init_batch_norm_stats(8)
+    y32, s32 = batch_norm(jnp.asarray(x32), stats, train=True)
+    y16, s16 = batch_norm(jnp.asarray(x32, jnp.bfloat16), stats, train=True)
+    assert y16.dtype == jnp.bfloat16
+    assert s16.mean.dtype == jnp.float32 and s16.var.dtype == jnp.float32
+    # Folded bf16 path tracks the exact f32 path to bf16 resolution.
+    np.testing.assert_allclose(
+        np.asarray(y16, dtype=np.float32), np.asarray(y32), atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(s16.mean), np.asarray(s32.mean), rtol=1e-2, atol=1e-3
+    )

@@ -192,8 +192,12 @@ def test_officehome_best_checkpoint_saved(tmp_path):
         ]
     )
     # The reference's model_best convention: highest-accuracy state kept
-    # in a dedicated subdir.
+    # in a dedicated subdir, with the accuracy persisted so crash-resume
+    # re-seeds best_acc instead of regressing the artifact.
     assert latest_step(os.path.join(ckpt, "best_gr_4")) is not None
+    from dwt_tpu.train.loop import _read_best_record
+
+    assert _read_best_record(ckpt) > 0.0
 
 
 def test_checkpoint_resave_and_keep(tmp_path):
