@@ -37,7 +37,9 @@ BASELINE_IMGS_PER_SEC = None
 _RELAY_VAR = "PALLAS_AXON_POOL_IPS"
 # Backend init + one tiny compile (first compile 20-40s); overridable so a
 # wedged-relay environment fails fast when the operator knows it's down.
-_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+# Budgeted so the worst case (2 hung probes + retry sleep + CPU-fallback
+# lenet run, ~6 min total) stays inside a 10-minute driver timeout.
+_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
 
 # Peak dense bf16 FLOP/s per chip by device-kind substring (public specs).
 _PEAK_FLOPS = [
@@ -223,7 +225,7 @@ def _reexec_cpu_fallback(args) -> int:
         # real number in bounded time.
         "lenet",
         "--steps",
-        str(min(args.steps, 20)),
+        str(min(args.steps, 10)),
         "--no-probe",
         "--fallback-note",
         "tpu backend init failed twice; clean-env cpu rerun",

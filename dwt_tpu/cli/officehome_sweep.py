@@ -99,8 +99,10 @@ def main(argv=None) -> float:
         results[f"{source}->{target}"] = acc
         print(f"[sweep] {source}->{target}: {acc:.2f}")
         if args.results_json:
-            # Written after EVERY pair so a crash keeps completed results.
-            with open(args.results_json, "w") as f:
+            # Written atomically after EVERY pair so a crash at any point
+            # keeps all completed results.
+            tmp = args.results_json + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump(
                     {
                         "pairs": results,
@@ -111,6 +113,7 @@ def main(argv=None) -> float:
                     f,
                     indent=2,
                 )
+            os.replace(tmp, args.results_json)
 
     mean = sum(results.values()) / max(len(results), 1)
     print(f"[sweep] mean over {len(results)} pairs: {mean:.2f}")

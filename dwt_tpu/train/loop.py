@@ -18,6 +18,7 @@ single-host data parallelism over all local devices.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Callable, Iterator, Optional, Tuple
 
@@ -170,22 +171,21 @@ def _write_best_record(ckpt_dir: str, accuracy: float, step: int) -> None:
     """Persist the best accuracy so crash-resume cannot regress the
     "model_best" artifact (a resumed run re-seeds ``best_acc`` from this
     instead of -1.0 and overwriting a better pre-crash checkpoint)."""
-    import json
-
     os.makedirs(ckpt_dir, exist_ok=True)
-    with open(_best_record_path(ckpt_dir), "w") as f:
+    path = _best_record_path(ckpt_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"accuracy": accuracy, "step": step}, f)
+    os.replace(tmp, path)
 
 
 def _read_best_record(ckpt_dir: Optional[str]) -> float:
-    import json
-
     if not ckpt_dir or not os.path.exists(_best_record_path(ckpt_dir)):
         return -1.0
     try:
         with open(_best_record_path(ckpt_dir)) as f:
             return float(json.load(f)["accuracy"])
-    except (ValueError, KeyError, OSError):
+    except (ValueError, TypeError, KeyError, OSError):
         return -1.0
 
 
@@ -485,9 +485,14 @@ def run_officehome(
                        "training from fresh init")
 
     start_iter = 0
+    best_acc = -1.0
     if cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None:
         state = restore_state(cfg.ckpt_dir, state)
         start_iter = int(state.step)
+        # Resume-only: a from-scratch restart (no periodic checkpoint) must
+        # not inherit a stale best record from a dead trajectory — its
+        # model_best would never update.
+        best_acc = _read_best_record(cfg.ckpt_dir)
         logger.log("resume", start_iter)
 
     train_step = wrap(
@@ -530,7 +535,6 @@ def run_officehome(
         train_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
     )
     acc = 0.0
-    best_acc = _read_best_record(cfg.ckpt_dir)
     for it, batch in enumerate(batches, start=start_iter):
         state, metrics = train_step(state, batch)
         if it % cfg.log_interval == 0:
