@@ -17,10 +17,9 @@ single-host data parallelism over all local devices.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
-from typing import Callable, Iterator, Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
