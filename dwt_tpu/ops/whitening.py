@@ -98,7 +98,17 @@ def group_cov(
     acc_dtype = jnp.promote_types(xn.dtype, jnp.float32)
     t = xn.reshape(-1, num_groups, group_size).astype(acc_dtype)
     m = t.shape[0]
-    cov = jnp.einsum("mgc,mgd->gcd", t, t, preferred_element_type=acc_dtype)
+    # HIGHEST precision: on TPU the default lowers f32 matmuls to bf16
+    # passes — fine for activations, not for the statistics that feed a
+    # Cholesky factorization (the eps shrinkage guards PSD-ness, not
+    # accuracy). The [G,g,g] output is tiny; the cost is negligible.
+    cov = jnp.einsum(
+        "mgc,mgd->gcd",
+        t,
+        t,
+        preferred_element_type=acc_dtype,
+        precision=lax.Precision.HIGHEST,
+    )
     if axis_name is not None:
         cov = lax.psum(cov, axis_name)
         m = m * lax.psum(1, axis_name)
