@@ -117,41 +117,49 @@ def _t2n(t):
     return t.detach().numpy().astype(np.float32)
 
 
-def _flax_variables_from_torch(tm, variables):
-    """Tie the flax model to the torch twin's weights (layouts converted)."""
-    params = jax.tree.map(lambda a: a, variables["params"])
+def _lenet_tree_from_torch(tm, get):
+    """Map LeNet twin tensors (weights via ``get=lambda p: p`` or grads via
+    ``get=lambda p: p.grad``) into the flax param-tree layout — the same
+    transposes apply to both, since a gradient has its parameter's layout."""
 
     def conv_kernel(w):  # OIHW -> HWIO
-        return jnp.asarray(_t2n(w).transpose(2, 3, 1, 0))
+        return jnp.asarray(_t2n(get(w)).transpose(2, 3, 1, 0))
 
+    params = {}
     params["conv1"] = {
         "kernel": conv_kernel(tm.conv1.weight),
-        "bias": jnp.asarray(_t2n(tm.conv1.bias)),
+        "bias": jnp.asarray(_t2n(get(tm.conv1.bias))),
     }
     params["conv2"] = {
         "kernel": conv_kernel(tm.conv2.weight),
-        "bias": jnp.asarray(_t2n(tm.conv2.bias)),
+        "bias": jnp.asarray(_t2n(get(tm.conv2.bias))),
     }
     # fc3 consumes the flatten of [7,7,48] (NHWC) in flax but [48,7,7]
     # (NCHW) in torch — permute the input-dim blocks accordingly.
-    w3 = _t2n(tm.fc3.weight).reshape(100, 48, 7, 7).transpose(0, 2, 3, 1)
+    w3 = _t2n(get(tm.fc3.weight)).reshape(100, 48, 7, 7).transpose(0, 2, 3, 1)
     params["fc3"] = {
         "kernel": jnp.asarray(w3.reshape(100, 2352).T),
-        "bias": jnp.asarray(_t2n(tm.fc3.bias)),
+        "bias": jnp.asarray(_t2n(get(tm.fc3.bias))),
     }
     for name, lin in (("fc4", tm.fc4), ("fc5", tm.fc5)):
         params[name] = {
-            "kernel": jnp.asarray(_t2n(lin.weight).T),
-            "bias": jnp.asarray(_t2n(lin.bias)),
+            "kernel": jnp.asarray(_t2n(get(lin.weight)).T),
+            "bias": jnp.asarray(_t2n(get(lin.bias))),
         }
     for i, (g, b) in enumerate(
         [(tm.g1, tm.b1), (tm.g2, tm.b2), (tm.g3, tm.b3), (tm.g4, tm.b4), (tm.g5, tm.b5)],
         start=1,
     ):
         params[f"dn{i}"] = {
-            "gamma": jnp.asarray(_t2n(g).reshape(-1)),
-            "beta": jnp.asarray(_t2n(b).reshape(-1)),
+            "gamma": jnp.asarray(_t2n(get(g)).reshape(-1)),
+            "beta": jnp.asarray(_t2n(get(b)).reshape(-1)),
         }
+    return params
+
+
+def _flax_variables_from_torch(tm, variables):
+    """Tie the flax model to the torch twin's weights (layouts converted)."""
+    params = _lenet_tree_from_torch(tm, lambda p: p)
     return {"params": params, "batch_stats": variables["batch_stats"]}
 
 
@@ -525,3 +533,54 @@ def test_full_tiny_resnet_matches_torch():
     np.testing.assert_allclose(
         np.asarray(out_f), _t2n(out_t), rtol=1e-3, atol=5e-4
     )
+
+
+def test_gradients_match_torch(tied_models):
+    """Backward parity through the whole model: the digits training loss
+    (cls + 0.1*entropy, ``usps_mnist.py:298-299``) must produce the same
+    parameter gradients in both frameworks — including through the
+    whitening Cholesky/inverse (their VJPs differ in implementation but
+    must agree in value)."""
+    from dwt_tpu.ops import entropy_loss, softmax_cross_entropy
+
+    tm, fm, variables, x = tied_models
+    n = x.shape[1]
+    y = np.random.default_rng(11).integers(0, 10, size=(n,))
+
+    # torch side: train-mode forward, composite loss, backward.
+    tm.train()
+    out = tm(_torch_input(x))
+    src, tgt = out[:n], out[n:]
+    cls = F.nll_loss(F.log_softmax(src, dim=1), torch.from_numpy(y))
+    p = F.softmax(tgt, dim=1)
+    ent = torch.mean(torch.sum(-p * torch.log(p), dim=1))
+    (cls + 0.1 * ent).backward()
+    want = _lenet_tree_from_torch(tm, lambda t: t.grad)
+
+    # flax side: identical loss on the tied params.
+    def loss_fn(params):
+        logits, _ = fm.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.asarray(x),
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return softmax_cross_entropy(
+            logits[0], jnp.asarray(y)
+        ) + 0.1 * entropy_loss(logits[1])
+
+    got = jax.grad(loss_fn)(variables["params"])
+
+    # Structure-aware comparison: tree_map_with_path asserts identical key
+    # structure up front, so a renamed key fails loudly instead of
+    # mispairing leaves.
+    def compare(path, w, g):
+        np.testing.assert_allclose(
+            np.asarray(g),
+            np.asarray(w),
+            rtol=2e-3,
+            atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+    jax.tree_util.tree_map_with_path(compare, want, got)
