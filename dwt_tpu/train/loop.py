@@ -95,7 +95,28 @@ def _maybe_init_distributed(cfg) -> None:
         return
     from dwt_tpu.parallel import initialize_distributed
 
-    initialize_distributed()
+    def _int_env(name):
+        value = os.environ.get(name)
+        return int(value) if value else None
+
+    try:
+        # Cloud TPU / SLURM / k8s auto-detect when the env vars are absent;
+        # bare-metal DCN setups pass explicit values through DWT_* vars
+        # (jax itself reads no num-processes/process-id env vars).
+        initialize_distributed(
+            coordinator_address=os.environ.get("DWT_COORDINATOR_ADDRESS"),
+            num_processes=_int_env("DWT_NUM_PROCESSES"),
+            process_id=_int_env("DWT_PROCESS_ID"),
+        )
+    except (ValueError, RuntimeError) as e:
+        raise RuntimeError(
+            "--distributed could not auto-detect the cluster (Cloud TPU "
+            "pod/slice, SLURM, and k8s are auto-detected when the same "
+            "command launches on every host). For bare-metal, set "
+            "DWT_COORDINATOR_ADDRESS, DWT_NUM_PROCESSES, and "
+            "DWT_PROCESS_ID; or drop --distributed for single-host runs. "
+            f"Underlying error: {e}"
+        ) from e
 
 
 def _multihost_data_split(cfg, bs: int) -> Tuple[int, Optional[Tuple[int, int]]]:
