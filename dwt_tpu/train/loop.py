@@ -97,25 +97,43 @@ def _maybe_init_distributed(cfg) -> None:
 
     def _int_env(name):
         value = os.environ.get(name)
-        return int(value) if value else None
+        try:
+            return int(value) if value else None
+        except ValueError:
+            raise RuntimeError(
+                f"--distributed: {name}={value!r} is not an integer"
+            ) from None
 
+    # Cloud TPU / SLURM / k8s auto-detect when the env vars are absent;
+    # bare-metal DCN setups pass explicit values through DWT_* vars (jax
+    # itself reads no num-processes/process-id env vars).
+    coordinator = os.environ.get("DWT_COORDINATOR_ADDRESS")
+    num_processes = _int_env("DWT_NUM_PROCESSES")
+    process_id = _int_env("DWT_PROCESS_ID")
+    explicit = coordinator or num_processes is not None or process_id is not None
     try:
-        # Cloud TPU / SLURM / k8s auto-detect when the env vars are absent;
-        # bare-metal DCN setups pass explicit values through DWT_* vars
-        # (jax itself reads no num-processes/process-id env vars).
         initialize_distributed(
-            coordinator_address=os.environ.get("DWT_COORDINATOR_ADDRESS"),
-            num_processes=_int_env("DWT_NUM_PROCESSES"),
-            process_id=_int_env("DWT_PROCESS_ID"),
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
         )
     except (ValueError, RuntimeError) as e:
+        if explicit:
+            detail = (
+                "explicit DWT_* configuration failed — check that all three "
+                "of DWT_COORDINATOR_ADDRESS, DWT_NUM_PROCESSES, and "
+                "DWT_PROCESS_ID are set and the coordinator is reachable."
+            )
+        else:
+            detail = (
+                "could not auto-detect the cluster (Cloud TPU pod/slice, "
+                "SLURM, and k8s are auto-detected when the same command "
+                "launches on every host). For bare-metal, set "
+                "DWT_COORDINATOR_ADDRESS, DWT_NUM_PROCESSES, and "
+                "DWT_PROCESS_ID; or drop --distributed for single-host runs."
+            )
         raise RuntimeError(
-            "--distributed could not auto-detect the cluster (Cloud TPU "
-            "pod/slice, SLURM, and k8s are auto-detected when the same "
-            "command launches on every host). For bare-metal, set "
-            "DWT_COORDINATOR_ADDRESS, DWT_NUM_PROCESSES, and "
-            "DWT_PROCESS_ID; or drop --distributed for single-host runs. "
-            f"Underlying error: {e}"
+            f"--distributed: {detail} Underlying error: {e}"
         ) from e
 
 
