@@ -175,12 +175,32 @@ def _time_steps(step, state, batch, steps, imgs_per_step):
     return imgs_per_step * steps / dt, dt / steps, flops_per_step
 
 
-def _probe_backend() -> bool:
+def _relay_diagnosis(mode: str = "hung") -> str:
+    """Distinguish 'tunnel down' from 'claim wedged': the axon client dials
+    the loopback relay on :8082/:8083; if neither accepts a TCP connection,
+    the gRPC client retries a refused connection forever and no amount of
+    waiting helps.  ``mode`` names the observed failure ("hung" timeout vs
+    "errored" nonzero exit) so the recorded note matches what happened."""
+    import socket
+
+    open_ports = []
+    for port in (8082, 8083):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                open_ports.append(port)
+        except OSError:
+            pass
+    if not open_ports:
+        return "relay ports 8082/8083 refused — TPU tunnel is not running"
+    return f"relay port(s) {open_ports} open but init {mode} — claim wedged?"
+
+
+def _probe_backend():
     """Initialize the default backend in a subprocess with a timeout.
 
-    Returns True if `jax.devices()` + one tiny computation complete; False on
-    nonzero exit, timeout, or hang (the wedged-claim mode observed on the
-    relay).
+    Returns None on success (`jax.devices()` + one tiny computation
+    complete); otherwise the observed failure mode, "hung" (timeout) or
+    "errored" (nonzero exit).
     """
     code = (
         "import jax, jax.numpy as jnp; "
@@ -197,10 +217,10 @@ def _probe_backend() -> bool:
     except subprocess.TimeoutExpired:
         print(
             f"bench: backend probe hung >{_PROBE_TIMEOUT_S}s "
-            "(wedged relay claim?)",
+            f"({_relay_diagnosis('hung')})",
             file=sys.stderr,
         )
-        return False
+        return "hung"
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip().splitlines()[-3:]
         print(
@@ -208,11 +228,11 @@ def _probe_backend() -> bool:
             % (proc.returncode, " | ".join(tail)),
             file=sys.stderr,
         )
-        return False
-    return True
+        return "errored"
+    return None
 
 
-def _reexec_cpu_fallback(args) -> int:
+def _reexec_cpu_fallback(args, failure_mode: str) -> int:
     """Re-exec this script on CPU in a clean env; returns the child's rc."""
     env = {k: v for k, v in os.environ.items() if k != _RELAY_VAR}
     env["JAX_PLATFORMS"] = "cpu"
@@ -228,7 +248,8 @@ def _reexec_cpu_fallback(args) -> int:
         str(min(args.steps, 10)),
         "--no-probe",
         "--fallback-note",
-        "tpu backend init failed twice; clean-env cpu rerun",
+        f"tpu backend init failed twice "
+        f"({_relay_diagnosis(failure_mode)}); clean-env cpu rerun",
     ]
     return subprocess.call(cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
 
@@ -255,13 +276,13 @@ def main():
     args = ap.parse_args()
 
     if not args.no_probe:
-        ok = _probe_backend()
-        if not ok:
+        failure = _probe_backend()
+        if failure is not None:
             print("bench: retrying backend probe once...", file=sys.stderr)
             time.sleep(10)
-            ok = _probe_backend()
-        if not ok:
-            sys.exit(_reexec_cpu_fallback(args))
+            failure = _probe_backend()
+        if failure is not None:
+            sys.exit(_reexec_cpu_fallback(args, failure))
 
     import jax
 
