@@ -177,22 +177,32 @@ def _time_steps(step, state, batch, steps, imgs_per_step):
 
 def _relay_diagnosis(mode: str = "hung") -> str:
     """Distinguish 'tunnel down' from 'claim wedged': the axon client dials
-    the loopback relay on :8082/:8083; if neither accepts a TCP connection,
-    the gRPC client retries a refused connection forever and no amount of
-    waiting helps.  ``mode`` names the observed failure ("hung" timeout vs
-    "errored" nonzero exit) so the recorded note matches what happened."""
+    the relay host named by ``PALLAS_AXON_POOL_IPS`` on :8082/:8083; if
+    neither accepts a TCP connection, the gRPC client retries a refused
+    connection forever and no amount of waiting helps.  ``mode`` names the
+    observed failure ("hung" timeout vs "errored" nonzero exit) so the
+    recorded note matches what happened."""
     import socket
 
+    host = (os.environ.get(_RELAY_VAR) or "").split(",")[0].strip()
+    if not host:
+        return f"backend init {mode}; no TPU relay configured ({_RELAY_VAR} unset)"
     open_ports = []
     for port in (8082, 8083):
         try:
-            with socket.create_connection(("127.0.0.1", port), timeout=2):
+            with socket.create_connection((host, port), timeout=2):
                 open_ports.append(port)
         except OSError:
             pass
     if not open_ports:
-        return "relay ports 8082/8083 refused — TPU tunnel is not running"
-    return f"relay port(s) {open_ports} open but init {mode} — claim wedged?"
+        return (
+            f"relay {host} ports 8082/8083 refused — TPU tunnel is not "
+            "running"
+        )
+    return (
+        f"relay {host} port(s) {open_ports} open but init {mode} — "
+        "claim wedged?"
+    )
 
 
 def _probe_backend():

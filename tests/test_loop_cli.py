@@ -215,3 +215,46 @@ def test_checkpoint_resave_and_keep(tmp_path):
     save_state(ck, 7, state, keep=1)
     assert latest_step(ck) == 7
     assert sorted(os.listdir(ck)) == ["7"]
+
+
+@pytest.mark.slow
+def test_officehome_sweep_synthetic(tmp_path):
+    import json
+
+    from dwt_tpu.cli.officehome_sweep import main
+
+    results_json = tmp_path / "sweep.json"
+    mean = main(
+        [
+            "--synthetic",
+            "--synthetic_size", "12",
+            "--arch", "tiny",
+            "--img_crop_size", "32",
+            "--num_classes", "5",
+            "--source_batch_size", "6",
+            "--test_batch_size", "6",
+            "--num_iters", "1",
+            "--check_acc_step", "1",
+            "--stat_collection_passes", "0",
+            "--group_size", "4",
+            "--pairs", "Art:Clipart, Clipart:Art",
+            "--results_json", str(results_json),
+            "--metrics_jsonl", str(tmp_path / "m.jsonl"),
+        ]
+    )
+    assert 0.0 <= mean <= 100.0
+    data = json.loads(results_json.read_text())
+    assert set(data["pairs"]) == {"Art->Clipart", "Clipart->Art"}
+    assert data["completed"] == data["total"] == 2
+    # Per-pair metrics files (pair tag embedded in the filename).
+    assert (tmp_path / "m.Art2Clipart.jsonl").exists()
+    assert (tmp_path / "m.Clipart2Art.jsonl").exists()
+
+
+def test_officehome_sweep_rejects_bad_pairs():
+    from dwt_tpu.cli.officehome_sweep import main
+
+    with pytest.raises(SystemExit, match="Source:Target"):
+        main(["--synthetic", "--pairs", "ArtClipart"])
+    with pytest.raises(SystemExit, match="duplicates"):
+        main(["--synthetic", "--pairs", "Art:Clipart,Art:Clipart"])
