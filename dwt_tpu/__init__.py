@@ -20,7 +20,7 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 - ``dwt_tpu.cli``      — entrypoints mirroring the reference flag surfaces.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from dwt_tpu import ops  # noqa: F401
 from dwt_tpu import nn  # noqa: F401
