@@ -184,19 +184,35 @@ def _relay_diagnosis(mode: str = "hung") -> str:
     recorded note matches what happened."""
     import socket
 
-    host = (os.environ.get(_RELAY_VAR) or "").split(",")[0].strip()
-    if not host:
+    entry = (os.environ.get(_RELAY_VAR) or "").split(",")[0].strip()
+    if not entry:
         return f"backend init {mode}; no TPU relay configured ({_RELAY_VAR} unset)"
+    # The pool entry may carry an explicit ':port'; probe that port instead
+    # of assuming the default gRPC pair.  Bare IPv6 addresses contain many
+    # colons — only treat a single-colon entry (or bracketed [v6]:port) as
+    # host:port.
+    host, probe_ports = entry, (8082, 8083)
+    if entry.startswith("["):
+        bracket, _, port_s = entry.partition("]")
+        host = bracket[1:]
+        port_s = port_s.lstrip(":")
+        if port_s.isdigit():
+            probe_ports = (int(port_s),)
+    elif entry.count(":") == 1:
+        maybe_host, _, port_s = entry.partition(":")
+        if port_s.isdigit():
+            host, probe_ports = maybe_host, (int(port_s),)
     open_ports = []
-    for port in (8082, 8083):
+    for port in probe_ports:
         try:
             with socket.create_connection((host, port), timeout=2):
                 open_ports.append(port)
         except OSError:
             pass
     if not open_ports:
+        ports = "/".join(str(p) for p in probe_ports)
         return (
-            f"relay {host} ports 8082/8083 refused — TPU tunnel is not "
+            f"relay {host} ports {ports} refused — TPU tunnel is not "
             "running"
         )
     return (

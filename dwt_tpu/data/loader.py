@@ -105,24 +105,41 @@ def prefetch_to_device(
     put = transfer or (lambda item: jax.device_put(item, device))
     q: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded puts so the producer notices a consumer that stopped
+        # pulling (train-step exception, generator close()) instead of
+        # blocking forever with `size` device-resident batches pinned.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for item in iterator:
-                q.put(put(item))
+                if not _put(put(item)):
+                    return
         except BaseException as e:  # re-raised in the consumer below
-            q.put((sentinel, e))
+            _put((sentinel, e))
             return
-        q.put((sentinel, None))
+        _put((sentinel, None))
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
-    while True:
-        item = q.get()
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is sentinel:
-            if item[1] is not None:
-                # Batch assembly/augmentation/placement failures must abort
-                # the training run, not silently truncate the stream.
-                raise item[1]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is sentinel:
+                if item[1] is not None:
+                    # Batch assembly/augmentation/placement failures must
+                    # abort the training run, not silently truncate it.
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()  # unblocks the producer; queued batches become garbage

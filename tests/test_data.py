@@ -3,6 +3,7 @@
 import gzip
 import os
 import pickle
+import time
 
 import numpy as np
 import pytest
@@ -220,6 +221,37 @@ def test_prefetch_to_device_orders_and_places():
     next(stream)
     with pytest.raises(RuntimeError, match="corrupt image"):
         next(stream)
+
+
+def test_prefetch_producer_exits_when_consumer_abandons():
+    """An abandoned stream (train-step raised, sweep moved on) must release
+    its producer thread instead of leaving it blocked on a full queue with
+    device-resident batches pinned (advisor r3)."""
+    import threading
+
+    from dwt_tpu.data import prefetch_to_device
+
+    before = set(threading.enumerate())
+    produced = []
+
+    def endless():
+        i = 0
+        while True:
+            produced.append(i)
+            yield {"x": np.full((2,), i, np.float32)}
+            i += 1
+
+    stream = prefetch_to_device(endless(), size=2)
+    next(stream)
+    new_threads = [t for t in threading.enumerate() if t not in before]
+    stream.close()  # consumer abandons mid-stream
+
+    deadline = time.time() + 5.0
+    while any(t.is_alive() for t in new_threads) and time.time() < deadline:
+        time.sleep(0.05)
+    assert not any(t.is_alive() for t in new_threads), "producer thread leaked"
+    # Producer stopped near the queue bound, not arbitrarily far ahead.
+    assert len(produced) <= 6
 
 
 def test_infinite_restarts_epochs():
