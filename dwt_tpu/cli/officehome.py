@@ -13,7 +13,7 @@ def build_parser() -> argparse.ArgumentParser:
     d = OfficeHomeConfig()
     p = argparse.ArgumentParser(description="dwt_tpu DWT-MEC OfficeHome trainer")
     p.add_argument("--num_workers", type=int, default=d.num_workers,
-                   help="prefetch depth (no worker processes in dwt_tpu)")
+                   help="item-loading worker threads (decode+augment)")
     p.add_argument("--source_batch_size", type=int, default=d.source_batch_size)
     p.add_argument("--target_batch_size", type=int, default=d.target_batch_size,
                    help="accepted for parity; loaders use source_batch_size, "

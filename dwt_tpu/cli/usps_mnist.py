@@ -12,7 +12,7 @@ def build_parser() -> argparse.ArgumentParser:
     d = DigitsConfig()
     p = argparse.ArgumentParser(description="dwt_tpu digits (DIAL/DWT) trainer")
     p.add_argument("--num_workers", type=int, default=d.num_workers,
-                   help="prefetch depth (no worker processes in dwt_tpu)")
+                   help="item-loading worker threads (decode+augment)")
     p.add_argument("--source_batch_size", type=int, default=d.source_batch_size)
     p.add_argument("--target_batch_size", type=int, default=d.target_batch_size)
     p.add_argument("--test_batch_size", type=int, default=d.test_batch_size)

@@ -34,7 +34,7 @@ class DigitsConfig:
     group_size: int = 32  # README recommends 4; argparse default is 32
     lr_milestones: Tuple[int, ...] = (50, 80)  # epochs; MultiStepLR γ=0.1
     lr_gamma: float = 0.1
-    num_workers: int = 2  # prefetch depth here (no worker processes)
+    num_workers: int = 2  # item-loading worker threads (reference :332)
     data_root: str = "../data"
     # dwt_tpu extensions
     synthetic: bool = False  # run on generated data (no dataset files)
@@ -73,7 +73,7 @@ class OfficeHomeConfig:
     group_size: int = 4
     log_interval: int = 10
     seed: int = 1
-    num_workers: int = 2
+    num_workers: int = 2  # item-loading worker threads (reference :499)
     stat_collection_passes: int = 10  # eval_pass_collect_stats (:384)
     # dwt_tpu extensions
     arch: str = "resnet50"  # or "resnet101" (VisDA config)

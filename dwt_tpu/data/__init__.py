@@ -28,6 +28,7 @@ from dwt_tpu.data.transforms import (
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
+    ThreadLocalRng,
     ToArray,
     gaussian_blur,
     random_affine,
@@ -48,6 +49,7 @@ __all__ = [
     "RandomCrop",
     "RandomHorizontalFlip",
     "Resize",
+    "ThreadLocalRng",
     "ToArray",
     "gaussian_blur",
     "random_affine",

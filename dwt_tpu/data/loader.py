@@ -1,19 +1,36 @@
 """Batching, infinite restart, per-process sharding, device prefetch.
 
-Replaces ``torch.utils.data.DataLoader`` (the reference's only
-concurrency, ``usps_mnist.py:355-386``) with a thin sampler + a background
-prefetch thread: batches are assembled on the host while the TPU runs the
-previous step, and ``prefetch_to_device`` keeps ``size`` batches resident
-on device — the standard JAX double-buffering pattern.
+Replaces ``torch.utils.data.DataLoader`` (the reference's concurrency:
+``num_workers=2`` worker processes, ``usps_mnist.py:355-386``,
+``resnet50_dwt_mec_officehome.py:558-574``) with a thin sampler whose
+per-item work (decode + augment) runs on a thread pool
+(``num_workers`` in :func:`batch_iterator` — PIL/cv2/numpy release the
+GIL in the hot paths), plus a background prefetch thread:
+``prefetch_to_device`` keeps ``size`` batches resident on device — the
+standard JAX double-buffering pattern.
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Callable, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
+
+from dwt_tpu.data.transforms import set_item_seed
+
+
+def _load_item(dataset, i: int, token):
+    """``dataset[i]`` under an item-seed context: stochastic transforms
+    using ``ThreadLocalRng`` draw from a stream determined by ``token``
+    alone, so augmentations are reproducible across worker counts."""
+    set_item_seed(token)
+    try:
+        return dataset[int(i)]
+    finally:
+        set_item_seed(None)
 
 
 def _stack(parts):
@@ -21,6 +38,38 @@ def _stack(parts):
     if np.isscalar(first) or (isinstance(first, np.ndarray) and first.ndim == 0):
         return np.asarray(parts)
     return np.stack(parts)
+
+
+def _pooled_items(dataset, indices, num_workers: int, token_of) -> Iterator:
+    """Map ``dataset[i]`` over ``indices`` on a thread pool, in order.
+
+    The TPU-native stand-in for DataLoader worker *processes*: PIL decode,
+    cv2 warps, and numpy arithmetic all drop the GIL, so threads give real
+    parallel decode+augment without pickling datasets across processes.
+    A bounded in-flight window keeps memory proportional to the pool, and
+    a worker exception surfaces at the failing item's position in order.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    window = max(2 * num_workers, 8)
+    it = iter(indices)
+    ex = ThreadPoolExecutor(
+        max_workers=num_workers, thread_name_prefix="dwt-data"
+    )
+    try:
+        pending: "collections.deque" = collections.deque()
+        for i in it:
+            pending.append(ex.submit(_load_item, dataset, i, token_of(i)))
+            if len(pending) >= window:
+                break
+        while pending:
+            item = pending.popleft().result()
+            for i in it:  # top the window back up
+                pending.append(ex.submit(_load_item, dataset, i, token_of(i)))
+                break
+            yield item
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
 
 
 def batch_iterator(
@@ -31,6 +80,7 @@ def batch_iterator(
     seed: int = 0,
     epoch: int = 0,
     shard: Optional[Tuple[int, int]] = None,
+    num_workers: int = 0,
 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield tuples of stacked numpy batches from an indexable dataset.
 
@@ -42,7 +92,13 @@ def batch_iterator(
       ``count * batch_size`` so EVERY process yields the SAME number of
       batches — otherwise a ragged tail gives one process an extra
       collective train step and the job deadlocks;
-    * ``seed``/``epoch`` make shuffling deterministic per epoch.
+    * ``seed``/``epoch`` make shuffling deterministic per epoch;
+    * ``num_workers > 1``: per-item loading (decode + augment) runs on a
+      thread pool, order-preserving — the reference's ``num_workers``
+      DataLoader knob (``resnet50…py:558-574``).  Stochastic transforms
+      built on ``transforms.ThreadLocalRng`` draw from per-item seeded
+      streams (``(seed, epoch, sample_index)``), so a fixed-seed run is
+      bit-reproducible at ANY worker count, pooled or sequential.
     """
     n = len(dataset)
     order = np.arange(n)
@@ -55,13 +111,26 @@ def batch_iterator(
             order = order[:usable]
         order = order[index::count]
     stop = len(order) - (len(order) % batch_size if drop_last else 0)
-    for start in range(0, stop, batch_size):
-        idx = order[start : start + batch_size]
-        if not len(idx):
-            break
-        items = [dataset[int(i)] for i in idx]
-        yield tuple(_stack([item[f] for item in items])
-                    for f in range(len(items[0])))
+    indices = order[:stop]
+    token_of = lambda i: (seed, epoch, int(i))
+    if num_workers and num_workers > 1:
+        items_iter = _pooled_items(dataset, indices, num_workers, token_of)
+    else:
+        items_iter = (_load_item(dataset, i, token_of(i)) for i in indices)
+
+    def _emit(batch):
+        return tuple(
+            _stack([item[f] for item in batch]) for f in range(len(batch[0]))
+        )
+
+    batch = []
+    for item in items_iter:
+        batch.append(item)
+        if len(batch) == batch_size:
+            yield _emit(batch)
+            batch = []
+    if batch:  # trailing partial batch, drop_last=False only
+        yield _emit(batch)
 
 
 def infinite(

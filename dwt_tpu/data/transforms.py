@@ -9,6 +9,7 @@ Image until ``ToArray`` and an HWC float32 numpy array after.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -19,6 +20,69 @@ try:
     _HAS_CV2 = True
 except ImportError:  # pragma: no cover
     _HAS_CV2 = False
+
+
+_ITEM_SEED = threading.local()
+
+
+def set_item_seed(token) -> None:
+    """Declare the (hashable, int-tuple) identity of the item being loaded
+    on THIS thread; ``ThreadLocalRng`` derives its stream from it so an
+    item's augmentations depend only on (rng seed, item token) — never on
+    which worker thread loaded it.  ``batch_iterator`` sets this around
+    every ``dataset[i]`` call; ``None`` clears it."""
+    _ITEM_SEED.token = token
+
+
+class ThreadLocalRng:
+    """``np.random.Generator`` facade that is thread-safe AND item-deterministic.
+
+    ``np.random.Generator`` is not thread-safe; when ``batch_iterator``
+    runs ``dataset[i]`` on a worker pool, stochastic transforms sharing a
+    single generator would race.  Worse, per-*thread* streams would make a
+    fixed-seed run irreproducible (item→thread assignment is scheduler-
+    dependent).  So: while an item is being loaded (``set_item_seed``
+    active, which both loading paths of ``batch_iterator`` arrange), draws
+    come from a generator seeded by ``(seed, *item_token)`` — identical
+    whether the item loads sequentially, on any pool size, or on any
+    thread.  Outside item context each thread falls back to its own
+    spawned stream (valid draws, no races, no cross-run promise).
+    """
+
+    def __init__(self, seed: int = 0):
+        self._entropy = int(seed)
+        self._seq = np.random.SeedSequence(self._entropy)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def _gen(self) -> np.random.Generator:
+        token = getattr(_ITEM_SEED, "token", None)
+        if token is not None:
+            if getattr(self._local, "token", None) != token:
+                self._local.item_gen = np.random.default_rng(
+                    np.random.SeedSequence((self._entropy,) + tuple(token))
+                )
+                self._local.token = token
+            return self._local.item_gen
+        gen = getattr(self._local, "gen", None)
+        if gen is None:
+            with self._lock:  # SeedSequence.spawn mutates internal state
+                child = self._seq.spawn(1)[0]
+            gen = np.random.default_rng(child)
+            self._local.gen = gen
+        return gen
+
+    def integers(self, *args, **kwargs):
+        return self._gen().integers(*args, **kwargs)
+
+    def random(self, *args, **kwargs):
+        return self._gen().random(*args, **kwargs)
+
+    def normal(self, *args, **kwargs):
+        return self._gen().normal(*args, **kwargs)
+
+    def permutation(self, *args, **kwargs):
+        return self._gen().permutation(*args, **kwargs)
 
 
 class Compose:

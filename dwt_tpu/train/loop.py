@@ -34,6 +34,7 @@ from dwt_tpu.data import (
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
+    ThreadLocalRng,
     ToArray,
     batch_iterator,
     gaussian_blur,
@@ -227,14 +228,20 @@ def _read_best_record(ckpt_dir: Optional[str]) -> float:
         return -1.0
 
 
-def _evaluate(eval_step, state: TrainState, dataset, batch_size: int) -> dict:
+def _evaluate(
+    eval_step,
+    state: TrainState,
+    dataset,
+    batch_size: int,
+    num_workers: int = 0,
+) -> dict:
     """Accumulate eval counters; multi-host runs shard the test set per
     process and sum the counters across processes (the cross-replica sum
     of the reference ``test()`` accumulators, SURVEY §5)."""
     loss_sum, correct, count = 0.0, 0, 0
     for x, y in batch_iterator(
         dataset, batch_size, shuffle=False, drop_last=False,
-        shard=_process_shard(),
+        shard=_process_shard(), num_workers=num_workers,
     ):
         out = eval_step(
             state.params, state.batch_stats, jnp.asarray(x), jnp.asarray(y)
@@ -354,7 +361,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     if start_epoch >= cfg.epochs:
         # Resumed from a finished run: report the restored model's accuracy
         # instead of silently returning 0.0 without evaluating.
-        result = _evaluate(eval_step, state, target_test_ds, cfg.test_batch_size)
+        result = _evaluate(
+            eval_step, state, target_test_ds, cfg.test_batch_size,
+            num_workers=cfg.num_workers,
+        )
         logger.log("test", int(state.step), epoch=start_epoch, **result)
         return result["accuracy"]
 
@@ -362,11 +372,11 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     for epoch in range(start_epoch, cfg.epochs):
         source_iter = batch_iterator(
             source_ds, local_bs, shuffle=True, seed=cfg.seed, epoch=epoch,
-            shard=shard,
+            shard=shard, num_workers=cfg.num_workers,
         )
         target_iter = batch_iterator(
             target_ds, local_bs, shuffle=True, seed=cfg.seed + 1, epoch=epoch,
-            shard=shard,
+            shard=shard, num_workers=cfg.num_workers,
         )
 
         def epoch_batches():
@@ -378,9 +388,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 }
 
         # Host-side batch assembly overlaps device compute: the prefetch
-        # thread stages (and places) the next batches while the step runs.
+        # thread stages (and places) the next batches while the step runs;
+        # item decode/augment parallelism lives in batch_iterator's pool.
         batches = prefetch_to_device(
-            epoch_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
+            epoch_batches(), size=2, transfer=wrap_batch
         )
         for i, batch in enumerate(batches):
             state, metrics = train_step(state, batch)
@@ -392,7 +403,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                     cls_loss=metrics["cls_loss"],
                     entropy_loss=metrics["entropy_loss"],
                 )
-        result = _evaluate(eval_step, state, target_test_ds, cfg.test_batch_size)
+        result = _evaluate(
+            eval_step, state, target_test_ds, cfg.test_batch_size,
+            num_workers=cfg.num_workers,
+        )
         acc = result["accuracy"]
         logger.log("test", int(state.step), epoch=epoch, **result)
         if cfg.ckpt_dir and (
@@ -413,7 +427,7 @@ def _officehome_datasets(cfg: OfficeHomeConfig):
         tgt_x, tgt_y = _synthetic_classification_arrays(
             n, shape, cfg.num_classes, cfg.seed + 1, 0.5
         )
-        rng = np.random.default_rng(cfg.seed + 9)
+        rng = ThreadLocalRng(cfg.seed + 9)  # worker-pool-safe
         aug = lambda a: gaussian_blur(random_affine(a, rng=rng))
         source_ds = ArrayDataset(*src)
         target_ds = ArrayDataset(
@@ -428,7 +442,9 @@ def _officehome_datasets(cfg: OfficeHomeConfig):
 
     mean = [0.485, 0.456, 0.406]
     std = [0.229, 0.224, 0.225]
-    rng = np.random.default_rng(cfg.seed)
+    # Thread-local generator: the stochastic transforms run concurrently
+    # on batch_iterator's worker pool.
+    rng = ThreadLocalRng(cfg.seed)
     # Source/test transform (resnet50…py:527-532) and the target aug view
     # (:535-543): hflip → affine → blur before normalize.
     base_tf = Compose(
@@ -547,11 +563,13 @@ def run_officehome(
 
     source_stream = infinite(
         lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
-                                 seed=cfg.seed, epoch=e, shard=shard)
+                                 seed=cfg.seed, epoch=e, shard=shard,
+                                 num_workers=cfg.num_workers)
     )
     target_stream = infinite(
         lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
-                                 seed=cfg.seed + 1, epoch=e, shard=shard)
+                                 seed=cfg.seed + 1, epoch=e, shard=shard,
+                                 num_workers=cfg.num_workers)
     )
 
     def train_batches():
@@ -568,9 +586,10 @@ def run_officehome(
             }
 
     # Overlap host-side decode/augmentation with device compute (the aug
-    # pipeline is the expensive host stage for OfficeHome).
+    # pipeline is the expensive host stage for OfficeHome); the per-item
+    # decode/augment parallelism lives in batch_iterator's worker pool.
     batches = prefetch_to_device(
-        train_batches(), size=max(cfg.num_workers, 1), transfer=wrap_batch
+        train_batches(), size=2, transfer=wrap_batch
     )
     acc = 0.0
     for it, batch in enumerate(batches, start=start_iter):
@@ -584,7 +603,10 @@ def run_officehome(
                 mec_loss=metrics["mec_loss"],
             )
         if (it + 1) % cfg.check_acc_step == 0:
-            result = _evaluate(eval_step, state, test_ds, cfg.test_batch_size)
+            result = _evaluate(
+                eval_step, state, test_ds, cfg.test_batch_size,
+                num_workers=cfg.num_workers,
+            )
             acc = result["accuracy"]
             logger.log("test", int(state.step), iter=it, **result)
             if cfg.ckpt_dir and acc > best_acc:
@@ -603,16 +625,25 @@ def run_officehome(
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
             save_state(cfg.ckpt_dir, int(state.step), state)
 
+    # Release the abandoned infinite streams' worker pools and in-flight
+    # decoded batches before the stat-collection/eval phase.
+    source_stream.close()
+    target_stream.close()
+
     # Post-training protocol: N gradient-free train-mode passes over the
     # target TEST set with tripled data to re-estimate target stats
     # (resnet50…py:380-389), then the final test.
     for p in range(cfg.stat_collection_passes):
         for x, _ in batch_iterator(
-            test_ds, cfg.test_batch_size, shuffle=False, drop_last=False
+            test_ds, cfg.test_batch_size, shuffle=False, drop_last=False,
+            num_workers=cfg.num_workers,
         ):
             state = collect_step(state, jnp.asarray(x))
         logger.log("stat_collection", int(state.step), pass_index=p)
-    result = _evaluate(eval_step, state, test_ds, cfg.test_batch_size)
+    result = _evaluate(
+        eval_step, state, test_ds, cfg.test_batch_size,
+        num_workers=cfg.num_workers,
+    )
     acc = result["accuracy"]
     logger.log("final_test", int(state.step), **result)
     if cfg.ckpt_dir:

@@ -223,6 +223,94 @@ def test_prefetch_to_device_orders_and_places():
         next(stream)
 
 
+def test_batch_iterator_worker_pool_matches_sequential():
+    """Pooled item loading must be order-preserving: identical batches to
+    the single-threaded path for every (shuffle, drop_last, shard) combo."""
+    images = np.arange(37, dtype=np.float32)[:, None]
+    ds = ArrayDataset(images, np.arange(37))
+    for kwargs in (
+        dict(shuffle=False, drop_last=False),
+        dict(shuffle=True, drop_last=True, seed=3, epoch=2),
+        dict(shuffle=True, drop_last=True, shard=(1, 2)),
+    ):
+        seq = list(batch_iterator(ds, 4, **kwargs))
+        pooled = list(batch_iterator(ds, 4, num_workers=4, **kwargs))
+        assert len(seq) == len(pooled)
+        for (sx, sy), (px, py) in zip(seq, pooled):
+            np.testing.assert_array_equal(sx, px)
+            np.testing.assert_array_equal(sy, py)
+
+
+def test_worker_pool_stochastic_augs_reproducible():
+    """Augmentation draws must depend on (seed, epoch, item) only — the
+    same batches bit-for-bit at ANY worker count, and across reruns."""
+    from dwt_tpu.data import ThreadLocalRng
+
+    rng = ThreadLocalRng(11)
+    images = np.random.default_rng(0).normal(
+        size=(20, 6, 6, 1)
+    ).astype(np.float32)
+    ds = ArrayDataset(
+        images,
+        np.arange(20),
+        transform=lambda a: a + np.float32(rng.normal()),
+    )
+
+    def epoch(w):
+        return [
+            b[0]
+            for b in batch_iterator(
+                ds, 4, shuffle=True, seed=5, epoch=1, num_workers=w
+            )
+        ]
+
+    runs = [epoch(w) for w in (0, 2, 4)]
+    for other in runs[1:]:
+        for a, b in zip(runs[0], other):
+            np.testing.assert_array_equal(a, b)
+    # And a rerun at the same worker count reproduces itself.
+    for a, b in zip(runs[1], epoch(2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_batch_iterator_worker_pool_propagates_errors():
+    class Corrupt:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            if i == 9:
+                raise OSError("truncated jpeg")
+            return np.float32(i), i
+
+    stream = batch_iterator(Corrupt(), 4, shuffle=False, num_workers=4)
+    got = [next(stream) for _ in range(2)]  # items 0..7 fine
+    assert len(got) == 2
+    with pytest.raises(OSError, match="truncated jpeg"):
+        next(stream)
+
+
+def test_thread_local_rng_streams_are_independent_and_safe():
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dwt_tpu.data import ThreadLocalRng
+
+    rng = ThreadLocalRng(7)
+
+    def draw(_):
+        return [float(rng.random()) for _ in range(100)]
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        streams = list(ex.map(draw, range(4)))
+    for s in streams:
+        assert all(0.0 <= v < 1.0 for v in s)
+    # Same-thread draws continue one stream; the facade also answers the
+    # Generator API the transforms use.
+    assert rng.integers(0, 10) in range(10)
+    assert np.isfinite(rng.normal())
+    assert sorted(rng.permutation(5)) == [0, 1, 2, 3, 4]
+
+
 def test_prefetch_producer_exits_when_consumer_abandons():
     """An abandoned stream (train-step raised, sweep moved on) must release
     its producer thread instead of leaving it blocked on a full queue with

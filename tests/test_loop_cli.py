@@ -197,7 +197,9 @@ def test_officehome_best_checkpoint_saved(tmp_path):
     assert latest_step(os.path.join(ckpt, "best_gr_4")) is not None
     from dwt_tpu.train.loop import _read_best_record
 
-    assert _read_best_record(ckpt) > 0.0
+    # The record must exist (missing -> -1.0); the accuracy VALUE of a
+    # 2-iteration model on 6 images is rng-dependent and may be 0.0.
+    assert _read_best_record(ckpt) >= 0.0
 
 
 def test_checkpoint_resave_and_keep(tmp_path):

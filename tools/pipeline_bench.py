@@ -1,0 +1,116 @@
+"""Host input-pipeline microbench: decode+augment imgs/s, no model.
+
+Measures what the host can feed the chip: synthetic JPEGs are written once
+to a temp dir, then the OfficeHome dual-view pipeline (resize 256 → crop
+224 → hflip → affine → blur → normalize, ``resnet50…py:527-543``) is timed
+through ``batch_iterator`` at several worker counts.  Compare against the
+device roofline in PERF.md (2–3.5k imgs/s/chip for ResNet50-DWT): the
+pipeline must meet or beat the device rate or training is host-bound —
+the reason ``num_workers`` is a real worker pool, not just queue depth.
+
+Prints one JSON line per worker count:
+``{"workers": N, "imgs_per_sec": X, "dual_view": true, ...}``
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dwt_tpu.data import (
+    Compose,
+    ImageFolderDataset,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    Resize,
+    ThreadLocalRng,
+    ToArray,
+    batch_iterator,
+    gaussian_blur,
+    random_affine,
+)
+
+MEAN = [0.485, 0.456, 0.406]
+STD = [0.229, 0.224, 0.225]
+
+
+def write_synthetic_jpegs(root: str, n: int, size: int, classes: int = 2):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        d = os.path.join(root, f"class_{i % classes}")
+        os.makedirs(d, exist_ok=True)
+        arr = rng.integers(0, 256, size=(size, size, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(d, f"img_{i:05d}.jpg"), quality=88
+        )
+
+
+def build_dataset(root: str, resize: int, crop: int, seed: int = 0):
+    rng = ThreadLocalRng(seed)
+    base_tf = Compose(
+        [Resize(resize), RandomCrop(crop, rng=rng), ToArray(),
+         Normalize(MEAN, STD)]
+    )
+    aug_tf = Compose(
+        [Resize(resize), RandomCrop(crop, rng=rng),
+         RandomHorizontalFlip(rng=rng), ToArray(),
+         lambda a: random_affine(a, rng=rng), gaussian_blur,
+         Normalize(MEAN, STD)]
+    )
+    return ImageFolderDataset(root, transform=base_tf, transform_aug=aug_tf)
+
+
+def run(dataset, batch: int, workers: int, min_seconds: float) -> dict:
+    # Warm one batch (imports, PIL caches), then time whole epochs until
+    # the clock budget is spent.
+    next(iter(batch_iterator(dataset, batch, shuffle=False,
+                             num_workers=workers)))
+    images = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < min_seconds:
+        for b in batch_iterator(dataset, batch, shuffle=True, seed=1,
+                                epoch=images, num_workers=workers):
+            images += b[0].shape[0]
+    dt = time.perf_counter() - t0
+    return {
+        "workers": workers,
+        "imgs_per_sec": round(images / dt, 1),
+        "dual_view": True,
+        "batch": batch,
+        "seconds": round(dt, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=256,
+                    help="synthetic JPEG count")
+    ap.add_argument("--jpeg_size", type=int, default=300)
+    ap.add_argument("--resize", type=int, default=256)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=18)
+    ap.add_argument("--seconds", type=float, default=5.0,
+                    help="min timing window per worker count")
+    ap.add_argument("--workers", type=int, nargs="*",
+                    default=[1, 2, 4, 8, 16])
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="dwt_pipe_bench_") as root:
+        write_synthetic_jpegs(root, args.images, args.jpeg_size)
+        ds = build_dataset(root, args.resize, args.crop)
+        for w in args.workers:
+            print(json.dumps(run(ds, args.batch, w, args.seconds)),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
