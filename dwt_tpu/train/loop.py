@@ -202,6 +202,18 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
     return model, wrap, lambda b: shard_batch(b, mesh)
 
 
+def _params_digest(state: TrainState) -> float:
+    """Order-stable scalar digest of the params, from process-LOCAL data
+    (``addressable_data``, no collective): on a healthy DP/multi-host run
+    every process must log the identical value — the cheap invariant that
+    replicas did not silently diverge."""
+    total = 0.0
+    for leaf in jax.tree.leaves(state.params):
+        arr = np.asarray(jax.device_get(leaf.addressable_data(0)), np.float64)
+        total += float(np.abs(arr).sum())
+    return total
+
+
 def _best_record_path(ckpt_dir: str) -> str:
     return os.path.join(ckpt_dir, "best.json")
 
@@ -366,6 +378,9 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             num_workers=cfg.num_workers,
         )
         logger.log("test", int(state.step), epoch=start_epoch, **result)
+        logger.log(
+            "params_digest", int(state.step), digest=_params_digest(state)
+        )
         return result["accuracy"]
 
     acc = 0.0
@@ -413,6 +428,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             (epoch + 1) % cfg.ckpt_every_epochs == 0 or epoch == cfg.epochs - 1
         ):
             save_state(cfg.ckpt_dir, int(state.step), state)
+    logger.log("params_digest", int(state.step), digest=_params_digest(state))
     return acc
 
 
@@ -634,9 +650,12 @@ def run_officehome(
     # target TEST set with tripled data to re-estimate target stats
     # (resnet50…py:380-389), then the final test.
     for p in range(cfg.stat_collection_passes):
+        # seed/epoch vary the per-item augmentation tokens so each pass
+        # draws fresh crops — N identical passes would defeat the
+        # stat-re-estimation protocol (resnet50…py:380-389).
         for x, _ in batch_iterator(
             test_ds, cfg.test_batch_size, shuffle=False, drop_last=False,
-            num_workers=cfg.num_workers,
+            seed=cfg.seed, epoch=p, num_workers=cfg.num_workers,
         ):
             state = collect_step(state, jnp.asarray(x))
         logger.log("stat_collection", int(state.step), pass_index=p)
@@ -646,6 +665,7 @@ def run_officehome(
     )
     acc = result["accuracy"]
     logger.log("final_test", int(state.step), **result)
+    logger.log("params_digest", int(state.step), digest=_params_digest(state))
     if cfg.ckpt_dir:
         save_state(cfg.ckpt_dir, int(state.step), state)
     return acc

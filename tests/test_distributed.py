@@ -1,0 +1,108 @@
+"""Multi-host execution test: 2 real processes, CPU fake devices.
+
+Executes the --distributed bring-up end-to-end — ``jax.distributed.
+initialize`` via DWT_* env vars (``loop.py:_maybe_init_distributed``),
+per-process data sharding (``_multihost_data_split`` +
+``batch_iterator(shard=...)``), global-batch assembly
+(``dp.shard_batch`` → ``make_array_from_process_local_data``), and the
+cross-process eval counter allgather (``loop.py:_evaluate``).  These
+paths only run when ``jax.process_count() > 1``, so they are untestable
+on the in-process 8-device mesh; this spawns two coordinated OS
+processes with 4 fake CPU devices each (SURVEY §4.4 extended to §5's
+distributed-backend obligation).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _read_jsonl(path: str):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _last(records, kind: str) -> dict:
+    matches = [r for r in records if r["kind"] == kind]
+    assert matches, f"no {kind!r} record logged"
+    return matches[-1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_digits(tmp_path):
+    port = _free_port()
+    procs, logs = [], []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            DWT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            DWT_NUM_PROCESSES="2",
+            DWT_PROCESS_ID=str(rank),
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        jsonl = str(tmp_path / f"metrics_{rank}.jsonl")
+        logs.append(jsonl)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+                    "--synthetic", "--synthetic_size", "64",
+                    "--distributed", "--data_parallel",
+                    "--epochs", "1",
+                    "--group_size", "4",
+                    "--source_batch_size", "8",
+                    "--target_batch_size", "8",
+                    "--test_batch_size", "8",
+                    "--num_workers", "0",
+                    "--metrics_jsonl", jsonl,
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=REPO,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed processes timed out (likely a collective "
+                    "deadlock — check per-process batch counts)")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
+
+    rec0, rec1 = (_read_jsonl(path) for path in logs)
+
+    # Eval counters were allgather'd: every process reports the GLOBAL
+    # test set (synthetic_size//2 = 32 samples) and the same accuracy.
+    t0, t1 = _last(rec0, "test"), _last(rec1, "test")
+    assert t0["count"] == 32 and t1["count"] == 32
+    assert t0["accuracy"] == t1["accuracy"]
+    assert t0["loss"] == pytest.approx(t1["loss"], rel=1e-6)
+
+    # Replicated params stayed in sync across processes.
+    d0, d1 = _last(rec0, "params_digest"), _last(rec1, "params_digest")
+    assert d0["digest"] == d1["digest"] != 0.0
+
+    # Both processes trained the same number of steps (no ragged tail).
+    assert _last(rec0, "test")["step"] == _last(rec1, "test")["step"] > 0
