@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distributed", action="store_true",
                    help="multi-host bring-up: call jax.distributed.initialize(); "
                         "launch the same command on every host")
+    p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
+                   help=">1: 2-D (dcn, data) mesh — pod-level DP across "
+                        "slices, per-slice reductions on ICI")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_iters", type=int, default=d.ckpt_every_iters)
     p.add_argument("--bf16", action="store_true")

@@ -41,6 +41,7 @@ class DigitsConfig:
     synthetic_size: int = 256
     data_parallel: bool = False  # shard over all local devices
     distributed: bool = False  # multi-host: jax.distributed.initialize()
+    dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     bf16: bool = False
@@ -81,6 +82,7 @@ class OfficeHomeConfig:
     synthetic_size: int = 64
     data_parallel: bool = False
     distributed: bool = False  # multi-host: jax.distributed.initialize()
+    dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
     bf16: bool = False

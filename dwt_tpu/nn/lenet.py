@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from flax import linen as fnn
 
 from dwt_tpu.nn.norms import (
+    AxisName,
     DomainBatchNorm,
     DomainWhiten,
     apply_domain_norm,
@@ -43,7 +44,7 @@ class LeNetDWT(fnn.Module):
     eval_domain: int = 1
     momentum: float = 0.1
     whiten_eps: float = 1e-3
-    axis_name: Optional[str] = None
+    axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
 
     def _norm(self, x, norm, train):

@@ -16,7 +16,7 @@ over the stacked stats; eval applies the ``eval_domain`` branch to the whole
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,9 @@ from dwt_tpu.ops.whitening import (
     group_whiten,
     init_whitening_stats,
 )
+
+# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
+AxisName = Union[str, Tuple[str, ...]]
 
 
 def merge_domains(x: jax.Array) -> jax.Array:
@@ -77,7 +80,7 @@ class DomainWhiten(fnn.Module):
     momentum: float = 0.1
     eps: float = 1e-3
     use_affine: bool = True
-    axis_name: Optional[str] = None
+    axis_name: Optional[AxisName] = None
 
     @fnn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -142,7 +145,7 @@ class DomainBatchNorm(fnn.Module):
     momentum: Optional[float] = 0.1
     eps: float = 1e-5
     use_affine: bool = True
-    axis_name: Optional[str] = None
+    axis_name: Optional[AxisName] = None
 
     @fnn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from flax import linen as fnn
 
 from dwt_tpu.nn.norms import (
+    AxisName,
     DomainBatchNorm,
     DomainWhiten,
     apply_domain_norm,
@@ -55,7 +56,7 @@ class BottleneckDWT(fnn.Module):
     num_domains: int = 3
     eval_domain: int = 1
     momentum: float = 0.1
-    axis_name: Optional[str] = None
+    axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
 
     expansion: int = 4
@@ -124,7 +125,7 @@ class ResNetDWT(fnn.Module):
     num_domains: int = 3
     eval_domain: int = 1
     momentum: float = 0.1
-    axis_name: Optional[str] = None
+    axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
     # False → every norm site (incl. stem) is a DomainBatchNorm: the
     # whitening-ablated twin used by tools/profile_step.py --ablate to

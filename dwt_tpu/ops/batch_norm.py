@@ -25,11 +25,14 @@ for data parallelism (SURVEY §5 distributed backend note).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
+AxisName = Union[str, Tuple[str, ...]]
 
 
 class BatchNormStats(NamedTuple):
@@ -68,7 +71,7 @@ def batch_norm(
     train: bool,
     momentum: Optional[float] = 0.1,
     eps: float = 1e-5,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[AxisName] = None,
 ) -> Tuple[jax.Array, BatchNormStats]:
     """Normalize channels-last ``x``; returns ``(y, new_stats)``.
 

@@ -34,12 +34,15 @@ Semantics matched to the reference (see tests/test_whitening.py):
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.scipy.linalg import solve_triangular
+
+# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
+AxisName = Union[str, Tuple[str, ...]]
 
 
 class WhiteningStats(NamedTuple):
@@ -88,7 +91,7 @@ def group_cov(
     xn: jax.Array,
     num_groups: int,
     group_size: int,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[AxisName] = None,
 ) -> jax.Array:
     """Biased per-group covariance of centered, channels-last ``xn``.
 
@@ -163,7 +166,7 @@ def group_whiten(
     train: bool,
     momentum: float = 0.1,
     eps: float = 1e-3,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[AxisName] = None,
 ) -> Tuple[jax.Array, WhiteningStats]:
     """Whiten channels-last ``x`` per group of channels.
 

@@ -15,6 +15,7 @@ exactly this, and ``tests/test_parallel.py`` pins sharded-vs-global parity.
 
 from dwt_tpu.parallel.mesh import (
     DATA_AXIS,
+    DCN_AXIS,
     make_mesh,
     initialize_distributed,
 )
@@ -26,6 +27,7 @@ from dwt_tpu.parallel.dp import (
 
 __all__ = [
     "DATA_AXIS",
+    "DCN_AXIS",
     "make_mesh",
     "initialize_distributed",
     "make_sharded_train_step",

@@ -31,31 +31,35 @@ try:  # jax >= 0.5 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from dwt_tpu.parallel.mesh import DATA_AXIS
+def _batch_spec(mesh: Mesh) -> P:
+    """Leading batch axis sharded over EVERY mesh axis — 1-D ``("data",)``
+    and 2-D ``("dcn", "data")`` meshes both flatten onto the sample dim."""
+    return P(tuple(mesh.axis_names))
 
 
 def make_sharded_train_step(
     step_fn: Callable,
     mesh: Mesh,
-    axis_name: str = DATA_AXIS,
     jit: bool = True,
 ) -> Callable:
     """shard_map a ``(state, batch) -> (state, metrics)`` step over ``mesh``.
 
-    ``step_fn`` must already carry ``axis_name`` internally (grad averaging,
-    op moment pmean) — build it with the same ``axis_name`` given here.
-    State is replicated; every batch leaf is sharded along its leading axis.
+    ``step_fn`` must already carry the mesh's axis name(s) internally (grad
+    averaging, op moment pmean) — build it with ``axis_name =
+    tuple(mesh.axis_names)`` (a bare string for the 1-D mesh).  State is
+    replicated; every batch leaf is sharded along its leading axis over all
+    mesh axes.
     """
     mapped = _shard_map(
         step_fn,
         mesh=mesh,
-        in_specs=(P(), P(axis_name)),
+        in_specs=(P(), _batch_spec(mesh)),
         out_specs=(P(), P()),
     )
     return jax.jit(mapped) if jit else mapped
 
 
-def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
     """Place every batch leaf with its leading axis sharded over the mesh.
 
     Single-process: a plain sharded ``device_put``.  Multi-host (the mesh
@@ -64,7 +68,7 @@ def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
     process_count))`` produced — and the leaves are assembled into global
     arrays whose leading axis is the concatenation over processes.
     """
-    sharding = NamedSharding(mesh, P(axis_name))
+    sharding = NamedSharding(mesh, _batch_spec(mesh))
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     import numpy as np

@@ -11,20 +11,38 @@ from jax.sharding import Mesh
 # Canonical name of the data-parallel mesh axis; the same string must be the
 # ``axis_name`` the model's norm sites pmean over.
 DATA_AXIS = "data"
+# Leading axis of the 2-D multi-slice mesh: crosses slice boundaries (DCN).
+DCN_AXIS = "dcn"
 
 
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     axis_name: str = DATA_AXIS,
+    dcn_slices: Optional[int] = None,
 ) -> Mesh:
-    """1-D data-parallel mesh over the given (default: all) devices.
+    """Data-parallel mesh over the given (default: all) devices.
 
-    On a pod slice, ``jax.devices()`` is already ordered so that neighboring
-    indices are ICI neighbors — a 1-D mesh keeps the gradient/moment
-    all-reduces on ICI.  Multi-slice (DCN) setups should reshape to a 2-D
-    ``("dcn", "data")`` mesh; that axis split is a caller decision.
+    1-D by default: on a pod slice, ``jax.devices()`` is already ordered so
+    that neighboring indices are ICI neighbors — a 1-D mesh keeps the
+    gradient/moment all-reduces on ICI.
+
+    ``dcn_slices=S`` (multi-slice / pod-level DP, BASELINE configs[4])
+    builds the 2-D ``(DCN_AXIS, axis_name)`` mesh instead: devices reshape
+    slice-major to ``[S, n_per_slice]`` (``jax.devices()`` orders devices
+    by slice on multislice deployments), so collectives over ``axis_name``
+    stay WITHIN a slice on ICI and only the ``S``-way reduction over
+    ``DCN_AXIS`` crosses the data-center network.  XLA lowers a
+    two-axis ``pmean``/``psum`` to the matching hierarchical reduction.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if dcn_slices and dcn_slices > 1:
+        n = len(devices)
+        if n % dcn_slices:
+            raise ValueError(
+                f"{n} devices cannot split into {dcn_slices} equal slices"
+            )
+        grid = np.asarray(devices).reshape(dcn_slices, n // dcn_slices)
+        return Mesh(grid, (DCN_AXIS, axis_name))
     return Mesh(np.asarray(devices), (axis_name,))
 
 

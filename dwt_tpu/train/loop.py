@@ -180,10 +180,19 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
     outside any mesh context and raises "unbound axis name".
     """
     if not getattr(cfg, "data_parallel", False) or jax.device_count() == 1:
+        if (getattr(cfg, "dcn_slices", 0) or 0) > 1:
+            # Fail loudly like --distributed does: silently training
+            # unsharded would waste the whole multi-slice allocation.
+            raise ValueError(
+                "--dcn_slices > 1 requires --data_parallel and more than "
+                "one device — the 2-D (dcn, data) mesh only exists on the "
+                "sharded path"
+            )
         model = step_fn_builder(axis_name=None, **model_kw)
         return model, jax.jit, jax.device_put
     from dwt_tpu.parallel import (
         DATA_AXIS,
+        DCN_AXIS,
         make_mesh,
         make_sharded_train_step,
         shard_batch,
@@ -196,9 +205,17 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
             f"{jax.device_count()} devices, so --source_batch_size "
             f"(= --target_batch_size) must be divisible by it; got {bs}"
         )
-    mesh = make_mesh()
-    model = step_fn_builder(axis_name=DATA_AXIS, **model_kw)
-    wrap = lambda fn: make_sharded_train_step(fn, mesh, axis_name=DATA_AXIS)
+    # Multi-slice (pod-level) DP: 2-D (dcn, data) mesh keeps per-slice
+    # reductions on ICI; the model pmeans over BOTH axes.
+    dcn = getattr(cfg, "dcn_slices", 0) or 0
+    if dcn > 1:
+        mesh = make_mesh(dcn_slices=dcn)
+        axis_name = (DCN_AXIS, DATA_AXIS)
+    else:
+        mesh = make_mesh()
+        axis_name = DATA_AXIS
+    model = step_fn_builder(axis_name=axis_name, **model_kw)
+    wrap = lambda fn: make_sharded_train_step(fn, mesh)
     return model, wrap, lambda b: shard_batch(b, mesh)
 
 

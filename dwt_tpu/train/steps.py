@@ -10,7 +10,7 @@ mapped axis; the model's norm sites must be built with the same
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +19,9 @@ from jax import lax
 
 from dwt_tpu.ops.losses import entropy_loss, mec_loss, nll_loss, softmax_cross_entropy
 from dwt_tpu.train.state import TrainState
+
+# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
+AxisName = Union[str, Tuple[str, ...]]
 
 Batch = Dict[str, jax.Array]
 Metrics = Dict[str, jax.Array]
@@ -40,13 +43,13 @@ def _apply_grads(
     )
 
 
-def _pmean_if(tree: Any, axis_name: Optional[str]) -> Any:
+def _pmean_if(tree: Any, axis_name: Optional[AxisName]) -> Any:
     if axis_name is None:
         return tree
     return lax.pmean(tree, axis_name)
 
 
-def _mean_grads_if(grads: Any, axis_name: Optional[str]) -> Any:
+def _mean_grads_if(grads: Any, axis_name: Optional[AxisName]) -> Any:
     """Turn per-replica gradients of a *local-mean* loss into the gradient
     of the global-mean loss.
 
@@ -70,7 +73,7 @@ def make_digits_train_step(
     model,
     tx: optax.GradientTransformation,
     lambda_entropy: float = 0.1,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[AxisName] = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """Digits (USPS↔MNIST) step: cls loss on source + λ·entropy on target.
 
@@ -109,7 +112,7 @@ def make_officehome_train_step(
     model,
     tx: optax.GradientTransformation,
     lambda_mec: float = 0.1,
-    axis_name: Optional[str] = None,
+    axis_name: Optional[AxisName] = None,
 ) -> Callable[[TrainState, Batch], Tuple[TrainState, Metrics]]:
     """OfficeHome step: cls on source + λ·MEC between the two target views.
 
@@ -148,7 +151,7 @@ def make_officehome_train_step(
 
 
 def make_eval_step(
-    model, axis_name: Optional[str] = None
+    model, axis_name: Optional[AxisName] = None
 ) -> Callable[[Any, Any, jax.Array, jax.Array], Metrics]:
     """Eval step accumulators matching the reference ``test()`` functions.
 

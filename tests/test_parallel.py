@@ -36,25 +36,28 @@ def _batch(n=8, seed=0):
     }
 
 
-def _run_parity(tx, steps=2):
+def _run_parity(tx, steps=2, dcn_slices=None):
     """Run the same batch through the global step and the 8-way DP step.
 
-    Returns ``(state_g, metrics_g, state_s, metrics_s)``.  Init is axis-free
-    (init must not trace collectives outside the mesh context); both steps
-    start from identical state.
+    ``dcn_slices=S`` uses the 2-D ``(dcn, data)`` mesh with two-axis
+    collectives instead of the 1-D mesh.  Returns ``(state_g, metrics_g,
+    state_s, metrics_s)``.  Init is axis-free (init must not trace
+    collectives outside the mesh context); both steps start from identical
+    state.
     """
     assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
-    mesh = make_mesh(jax.devices()[:8])
+    mesh = make_mesh(jax.devices()[:8], dcn_slices=dcn_slices)
+    axis_name = tuple(mesh.axis_names) if dcn_slices else DATA_AXIS
     batch = _batch(8)
 
     model_global = LeNetDWT(group_size=4)
-    model_dp = LeNetDWT(group_size=4, axis_name=DATA_AXIS)
+    model_dp = LeNetDWT(group_size=4, axis_name=axis_name)
     sample = jnp.stack([batch["source_x"], batch["target_x"]])
     state = create_train_state(model_global, jax.random.key(0), sample, tx)
 
     global_step = jax.jit(make_digits_train_step(model_global, tx, 0.1))
     dp_step = make_sharded_train_step(
-        make_digits_train_step(model_dp, tx, 0.1, axis_name=DATA_AXIS), mesh
+        make_digits_train_step(model_dp, tx, 0.1, axis_name=axis_name), mesh
     )
 
     state_g, metrics_g = state, None
@@ -125,6 +128,37 @@ def test_sharded_adam_step_matches_global_batch_semantics():
     _assert_tree_close(
         state_s.params, state_g.params, rtol=0.0, atol=2 * steps * lr
     )
+
+
+@pytest.mark.slow
+def test_2d_dcn_mesh_matches_global_batch():
+    """Multi-slice DP (BASELINE configs[4]): the 2-D ``(dcn, data)`` mesh
+    with two-axis moment/gradient/metric collectives reproduces the
+    single-device global-batch numerics, same bars as the 1-D SGD test."""
+    state_g, metrics_g, state_s, metrics_s = _run_parity(
+        optax.sgd(1e-2, momentum=0.9), dcn_slices=2
+    )
+    for k in metrics_g:
+        np.testing.assert_allclose(
+            float(metrics_s[k]), float(metrics_g[k]), rtol=1e-5, atol=1e-6
+        )
+    _assert_tree_close(state_s.params, state_g.params, rtol=1e-5, atol=1e-6)
+    _assert_tree_close(
+        state_s.batch_stats, state_g.batch_stats, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_make_mesh_dcn_shapes_and_errors():
+    from dwt_tpu.parallel import DCN_AXIS
+
+    mesh = make_mesh(jax.devices()[:8], dcn_slices=2)
+    assert mesh.axis_names == (DCN_AXIS, DATA_AXIS)
+    assert mesh.devices.shape == (2, 4)
+    # 1-D when dcn_slices is absent/1.
+    assert make_mesh(jax.devices()[:8]).axis_names == (DATA_AXIS,)
+    assert make_mesh(jax.devices()[:8], dcn_slices=1).axis_names == (DATA_AXIS,)
+    with pytest.raises(ValueError, match="equal slices"):
+        make_mesh(jax.devices()[:8], dcn_slices=3)
 
 
 def test_shard_batch_places_leading_axis_across_mesh():
