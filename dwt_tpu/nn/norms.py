@@ -16,7 +16,7 @@ over the stacked stats; eval applies the ``eval_domain`` branch to the whole
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple, Union
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,13 +24,11 @@ from flax import linen as fnn
 
 from dwt_tpu.ops.batch_norm import BatchNormStats, batch_norm, init_batch_norm_stats
 from dwt_tpu.ops.whitening import (
+    AxisName,
     WhiteningStats,
     group_whiten,
     init_whitening_stats,
 )
-
-# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
-AxisName = Union[str, Tuple[str, ...]]
 
 
 def merge_domains(x: jax.Array) -> jax.Array:

@@ -25,14 +25,13 @@ for data parallelism (SURVEY §5 distributed backend note).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple, Union
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
-AxisName = Union[str, Tuple[str, ...]]
+from dwt_tpu.ops.whitening import AxisName
 
 
 class BatchNormStats(NamedTuple):

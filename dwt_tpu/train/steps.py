@@ -10,7 +10,7 @@ mapped axis; the model's norm sites must be built with the same
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -18,10 +18,8 @@ import optax
 from jax import lax
 
 from dwt_tpu.ops.losses import entropy_loss, mec_loss, nll_loss, softmax_cross_entropy
+from dwt_tpu.ops.whitening import AxisName
 from dwt_tpu.train.state import TrainState
-
-# A mapped-axis name or a tuple of them (2-D dcn/data mesh).
-AxisName = Union[str, Tuple[str, ...]]
 
 Batch = Dict[str, jax.Array]
 Metrics = Dict[str, jax.Array]

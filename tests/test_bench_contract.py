@@ -52,6 +52,7 @@ def test_bench_fallback_chain_emits_contract_json():
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = "203.0.113.1"
     env["BENCH_PROBE_TIMEOUT_S"] = "5"
+    env["BENCH_RELAY_WAIT_S"] = "5"  # cheap TCP poll, shortened for CI
     env.pop("JAX_PLATFORMS", None)
     proc = subprocess.run(
         [sys.executable, "bench.py", "--steps", "3"],
@@ -62,3 +63,7 @@ def test_bench_fallback_chain_emits_contract_json():
     assert REQUIRED_KEYS <= set(record)
     assert record["backend"] == "cpu"
     assert "fallback" in record and "203.0.113.1" in record["fallback"]
+    # The fallback times the FLAGSHIP model (reduced 96px), not a stand-in.
+    assert "resnet50" in record["metric"]
+    assert record["image_size"] == 96
+    assert "baseline_imgs_per_sec" in record
