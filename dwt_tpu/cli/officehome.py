@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize bottleneck blocks in backward "
                         "(less HBM, ~1/3 more FLOPs) for larger batches")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--expect_accuracy", type=float, default=None,
+                   help="repro assertion: exit nonzero unless final target "
+                        "accuracy is within --tolerance of this (paper "
+                        "Table-3 value, see baselines/)")
+    p.add_argument("--tolerance", type=float, default=0.3,
+                   help="±%% band for --expect_accuracy (BASELINE "
+                        "north-star: 0.3)")
     p.add_argument("--debug_nans", action="store_true",
                    help="jax_debug_nans: fail fast at the op that produced a NaN "
                         "(the whitening Cholesky guard, SURVEY \u00a75)")
@@ -81,16 +88,24 @@ def config_from_args(args: argparse.Namespace) -> OfficeHomeConfig:
 
 def run_from_args(args: argparse.Namespace) -> float:
     """Shared entrypoint plumbing for the OfficeHome-recipe CLIs (this one
-    and ``dwt_tpu.cli.visda``): debug toggles, logger lifecycle, dispatch."""
+    and ``dwt_tpu.cli.visda``): debug toggles, logger lifecycle, dispatch,
+    and the optional --expect_accuracy repro assertion."""
     if args.debug_nans:
         import jax
 
         jax.config.update("jax_debug_nans", True)
     from dwt_tpu.train.loop import run_officehome
+    from dwt_tpu.utils import check_cli_accuracy
 
     logger = MetricLogger(jsonl_path=args.metrics_jsonl)
     try:
-        return run_officehome(config_from_args(args), logger)
+        acc = run_officehome(config_from_args(args), logger)
+        if not check_cli_accuracy(
+            acc, getattr(args, "expect_accuracy", None),
+            getattr(args, "tolerance", 0.3), logger,
+        ):
+            raise SystemExit(1)
+        return acc
     finally:
         logger.close()
 

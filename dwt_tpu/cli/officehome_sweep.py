@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help='subset like "Art:Clipart,Product:Art" '
                         "(default: all ordered pairs)")
     p.add_argument("--results_json", type=str, default=None)
+    p.add_argument("--expect_table", type=str, default=None,
+                   help='JSON {"Source->Target": acc_or_null} of paper '
+                        "Table-3 targets (see baselines/); after the sweep "
+                        "a per-pair ±tolerance verdict table is produced "
+                        "and the exit code reflects it")
     return p
 
 
@@ -65,7 +70,33 @@ def main(argv=None) -> float:
     if not args.synthetic and not args.dataset_root:
         raise SystemExit("--dataset_root is required unless --synthetic")
 
+    if getattr(args, "expect_accuracy", None) is not None:
+        # One value cannot assert 12 different pairs; refusing beats
+        # silently dropping the user's assertion.
+        raise SystemExit(
+            "--expect_accuracy is a single-run flag; the sweep takes "
+            "per-pair targets via --expect_table (see baselines/)"
+        )
+    expected = None
+    if args.expect_table:
+        from dwt_tpu.utils import load_expect_table
+
+        expected = load_expect_table(args.expect_table)
+
     pairs = _pairs(args)
+    if expected is not None:
+        # Fail fast on typo'd table keys before hours of training: every
+        # non-null expectation must correspond to a planned pair.
+        planned = {f"{s}->{t}" for s, t in pairs}
+        unknown = sorted(
+            k for k, v in expected.items()
+            if v is not None and k not in planned
+        )
+        if unknown:
+            raise SystemExit(
+                f"--expect_table entries match no planned pair: {unknown} "
+                f"(planned: {sorted(planned)})"
+            )
     if len(set(pairs)) != len(pairs):
         raise SystemExit(f"--pairs contains duplicates: {pairs}")
     if args.dataset_root:
@@ -83,6 +114,22 @@ def main(argv=None) -> float:
     results = {}
     base_ckpt = args.ckpt_dir
     base_jsonl = args.metrics_jsonl
+
+    def _payload(**extra):
+        return {
+            "pairs": results,
+            "mean": sum(results.values()) / max(len(results), 1),
+            "completed": len(results),
+            "total": len(pairs),
+            **extra,
+        }
+
+    def _write_results(**extra):
+        tmp = args.results_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_payload(**extra), f, indent=2)
+        os.replace(tmp, args.results_json)
+
     for source, target in pairs:
         tag = f"{source}2{target}"
         if args.dataset_root:
@@ -101,22 +148,30 @@ def main(argv=None) -> float:
         if args.results_json:
             # Written atomically after EVERY pair so a crash at any point
             # keeps all completed results.
-            tmp = args.results_json + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(
-                    {
-                        "pairs": results,
-                        "mean": sum(results.values()) / len(results),
-                        "completed": len(results),
-                        "total": len(pairs),
-                    },
-                    f,
-                    indent=2,
-                )
-            os.replace(tmp, args.results_json)
+            _write_results()
 
     mean = sum(results.values()) / max(len(results), 1)
     print(f"[sweep] mean over {len(results)} pairs: {mean:.2f}")
+
+    if expected is not None:
+        from dwt_tpu.utils import sweep_verdicts
+
+        summary = sweep_verdicts(results, expected, args.tolerance)
+        for pair, v in summary["pairs"].items():
+            if v.get("skipped"):
+                print(f"[verdict] {pair}: actual={v['actual']:.2f} "
+                      "(no expectation — fill baselines/ from the paper)")
+            else:
+                status = "OK" if v["ok"] else "FAIL"
+                print(f"[verdict] {pair}: actual={v['actual']:.2f} "
+                      f"expected={v['expected']:.2f} Δ={v['delta']:+.2f} "
+                      f"(±{v['tolerance']}) {status}")
+        print(f"[verdict] checked={summary['checked']} "
+              f"skipped={summary['skipped']} all_ok={summary['all_ok']}")
+        if args.results_json:
+            _write_results(verdicts=summary)
+        if summary["all_ok"] is False:
+            raise SystemExit(1)
     return mean
 
 

@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--expect_accuracy", type=float, default=None,
+                   help="repro assertion: exit nonzero unless final target "
+                        "accuracy is within --tolerance of this (paper "
+                        "digits-table value, see baselines/)")
+    p.add_argument("--tolerance", type=float, default=0.3,
+                   help="±%% band for --expect_accuracy (BASELINE "
+                        "north-star: 0.3)")
     p.add_argument("--debug_nans", action="store_true",
                    help="jax_debug_nans: fail fast at the op that produced a NaN "
                         "(the whitening Cholesky guard, SURVEY \u00a75)")
@@ -63,10 +70,16 @@ def main(argv=None) -> float:
 
         jax.config.update("jax_debug_nans", True)
     from dwt_tpu.train.loop import run_digits
+    from dwt_tpu.utils import check_cli_accuracy
 
     logger = MetricLogger(jsonl_path=args.metrics_jsonl)
     try:
-        return run_digits(config_from_args(args), logger)
+        acc = run_digits(config_from_args(args), logger)
+        if not check_cli_accuracy(
+            acc, args.expect_accuracy, args.tolerance, logger
+        ):
+            raise SystemExit(1)
+        return acc
     finally:
         logger.close()
 

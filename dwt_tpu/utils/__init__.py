@@ -1,4 +1,4 @@
-"""dwt_tpu.utils — metrics logging and checkpoint helpers."""
+"""dwt_tpu.utils — metrics logging, checkpoints, repro verdicts."""
 
 from dwt_tpu.utils.metrics import MetricLogger
 from dwt_tpu.utils.checkpoint import (
@@ -6,5 +6,20 @@ from dwt_tpu.utils.checkpoint import (
     restore_state,
     save_state,
 )
+from dwt_tpu.utils.repro import (
+    accuracy_verdict,
+    check_cli_accuracy,
+    load_expect_table,
+    sweep_verdicts,
+)
 
-__all__ = ["MetricLogger", "latest_step", "restore_state", "save_state"]
+__all__ = [
+    "MetricLogger",
+    "latest_step",
+    "restore_state",
+    "save_state",
+    "accuracy_verdict",
+    "check_cli_accuracy",
+    "load_expect_table",
+    "sweep_verdicts",
+]

@@ -24,7 +24,10 @@ class MetricLogger:
             "kind": kind,
             "step": int(step),
             "elapsed_s": round(time.time() - self._t0, 3),
-            **{k: (float(v) if hasattr(v, "__float__") else v)
+            # bool is an int subclass (and has __float__) — keep verdict
+            # flags as true/false in the JSON, not 0.0/1.0.
+            **{k: (v if isinstance(v, bool)
+                   else float(v) if hasattr(v, "__float__") else v)
                for k, v in values.items()},
         }
         pretty = " ".join(

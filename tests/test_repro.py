@@ -1,0 +1,99 @@
+"""Repro-verdict unit tests (dwt_tpu/utils/repro.py) — the assertion layer
+for the paper-accuracy north star (BASELINE ±0.3%)."""
+
+import json
+
+import pytest
+
+from dwt_tpu.utils import (
+    accuracy_verdict,
+    check_cli_accuracy,
+    load_expect_table,
+    sweep_verdicts,
+)
+
+
+def test_accuracy_verdict_band():
+    assert accuracy_verdict(50.0, 50.2, 0.3)["ok"]
+    assert accuracy_verdict(50.0, 49.8, 0.3)["ok"]
+    v = accuracy_verdict(50.0, 50.5, 0.3)
+    assert not v["ok"] and v["delta"] == pytest.approx(-0.5)
+
+
+def test_check_cli_accuracy_noop_without_expectation():
+    assert check_cli_accuracy(12.3, None, 0.3) is True
+
+
+class _Log:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, step, **values):
+        self.records.append((kind, values))
+
+
+def test_check_cli_accuracy_logs_verdict():
+    log = _Log()
+    assert check_cli_accuracy(50.0, 50.1, 0.3, log) is True
+    assert not check_cli_accuracy(50.0, 60.0, 0.3, log)
+    kinds = [k for k, _ in log.records]
+    assert kinds == ["accuracy_check", "accuracy_check"]
+    assert log.records[1][1]["ok"] is False
+
+
+def test_load_expect_table_nulls_and_metadata(tmp_path):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({
+        "_source": "fill from pdf",
+        "Art->Clipart": 50.9,
+        "Clipart->Art": None,
+    }))
+    table = load_expect_table(str(path))
+    assert table == {"Art->Clipart": 50.9, "Clipart->Art": None}
+    path.write_text(json.dumps({"Art->Clipart": "high"}))
+    with pytest.raises(ValueError, match="number or null"):
+        load_expect_table(str(path))
+    path.write_text(json.dumps([1, 2]))
+    with pytest.raises(ValueError, match="JSON object"):
+        load_expect_table(str(path))
+
+
+def test_sweep_verdicts_mixed_table():
+    results = {"A->B": 50.0, "B->A": 60.0, "A->C": 70.0}
+    expected = {"A->B": 50.2, "B->A": 61.0, "A->C": None}
+    s = sweep_verdicts(results, expected, 0.3)
+    assert s["pairs"]["A->B"]["ok"] is True
+    assert s["pairs"]["B->A"]["ok"] is False
+    assert s["pairs"]["A->C"]["skipped"] is True
+    assert s["checked"] == 2 and s["skipped"] == 1
+    assert s["all_ok"] is False
+    assert s["mean_actual"] == pytest.approx(60.0)
+    # mean_expected only when the table is fully filled.
+    assert "mean_expected" not in s
+    s2 = sweep_verdicts({"A->B": 50.0}, {"A->B": 50.1}, 0.3)
+    assert s2["all_ok"] is True and s2["mean_expected"] == 50.1
+
+
+def test_shipped_templates_parse():
+    import os
+
+    # The shipped baselines/ templates must load (all-null is valid).
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in ("officehome_table3.json", "digits.json"):
+        table = load_expect_table(os.path.join(root, "baselines", name))
+        assert table and all(v is None for v in table.values())
+
+
+def test_sweep_verdicts_flags_unmatched_expectations():
+    results = {"A->B": 50.0}
+    expected = {"A->B": 50.1, "A->Bee": 60.0}  # typo'd key
+    s = sweep_verdicts(results, expected, 0.3)
+    assert s["unmatched"] == ["A->Bee"]
+    assert s["all_ok"] is False  # despite the one checked pair passing
+
+
+def test_load_expect_table_rejects_bools(tmp_path):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"A->B": True}))
+    with pytest.raises(ValueError, match="number or null"):
+        load_expect_table(str(path))
