@@ -8,6 +8,9 @@ from dwt_tpu.ops.whitening import (  # noqa: F401
     whitening_matrix,
     apply_whitening,
 )
+from dwt_tpu.ops.pallas_whitening import (  # noqa: F401
+    pallas_group_whiten,
+)
 from dwt_tpu.ops.batch_norm import (  # noqa: F401
     BatchNormStats,
     init_batch_norm_stats,

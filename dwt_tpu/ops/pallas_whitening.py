@@ -1,0 +1,264 @@
+"""Pallas TPU kernels for the grouped whitening op (SURVEY §2.2 new-table).
+
+The fused grouped-whitening op mandated "where XLA fusion is insufficient"
+(math spec: reference ``utils/whitening.py:37-61``).  PERF.md's cost
+analysis found the chain is 1.4% of step FLOPs but touches the largest
+activations in the net; the win a hand-fused kernel can offer is HBM
+traffic, not compute.  This module implements that design so the go/no-go
+can be decided by *measurement* the moment the chip is reachable:
+
+* **Pass 1 — moments** (`_moments_call`): ONE read of ``x`` accumulates
+  both the channel sums and the per-group second-moment matrices in VMEM
+  f32 accumulators (``cov = E[xxᵀ] − m mᵀ`` instead of the two-pass
+  center-then-cov, which would read ``x`` twice — the rewrite XLA will not
+  do on its own).
+* **Factorization** stays in plain JAX: ``[G, g, g]`` Cholesky + triangular
+  solve is microscopic (g=4) and XLA handles it fine.
+* **Pass 2 — apply** (`_apply_call`): one read of ``x``, one write of
+  ``y = L⁻¹(x − m)`` with the matmul in the activation dtype (bf16 nets ride
+  the bf16 MXU path, f32 accumulation).
+
+Total HBM traffic: 2 reads + 1 write of ``x`` vs the XLA path's 3 reads +
+1 write (mean pass, cov pass over centered data, apply pass).
+
+Gradients: ``pallas_group_whiten`` is differentiable w.r.t. ``x`` via a
+``custom_vjp`` whose backward *recomputes* the pure-JAX forward
+(``dwt_tpu.ops.whitening``) and uses its VJP — exact same cotangents as the
+XLA path (pinned by tests), at remat-style extra backward FLOPs.  The
+hand-derived backward that reuses ``L⁻¹`` (PERF.md sketch) is only worth
+building if the measured trace says the chain matters.
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dwt_tpu.ops.whitening import (
+    WhiteningStats,
+    _resolve_groups,
+    _shrink,
+    whitening_matrix,
+)
+
+try:  # pallas is TPU-oriented; import lazily-tolerant for exotic builds
+    from jax.experimental import pallas as pl
+
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+# Rows per grid step. 512 keeps the f32 tile under ~0.5 MB at C=256.
+_TILE_M = 512
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------- pass 1
+
+
+def _moments_kernel(x_ref, s1_ref, s2_ref, *, total_rows, tile_m, g):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        s1_ref[:] = jnp.zeros_like(s1_ref)
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    # Mask rows past the ragged end (the out-of-range tail of the last
+    # block reads padding, which must not pollute the sums).
+    rows = lax.broadcasted_iota(jnp.int32, (tile_m, 1), 0) + i * tile_m
+    x = jnp.where(rows < total_rows, x, 0.0)
+    s1_ref[:] += jnp.sum(x, axis=0, keepdims=True)
+    c = x.shape[-1]
+    xg = x.reshape(tile_m, c // g, g)
+    # Batched over groups: [G, g, g] second-moment contribution.  HIGHEST
+    # precision as in the XLA op's group_cov: statistics feeding a Cholesky
+    # must not ride the TPU's default bf16 multiply passes — doubly so here,
+    # where the E[xxᵀ]−mmᵀ subtraction cancels leading bits.
+    prod = jnp.einsum(
+        "mgc,mgd->gcd",
+        xg,
+        xg,
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )
+    s2_ref[:] += prod.reshape(c, g)
+
+
+def _moments_call(
+    x2d: jax.Array, num_groups: int, group_size: int, interpret: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """(mean [C], biased cov [G, g, g]) in ONE pass over ``x2d [M, C]``."""
+    m_rows, c = x2d.shape
+    tile_m = min(_TILE_M, max(8, m_rows))
+    grid = (pl.cdiv(m_rows, tile_m),)
+    kernel = functools.partial(
+        _moments_kernel, total_rows=m_rows, tile_m=tile_m, g=group_size
+    )
+    s1, s2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((tile_m, c), lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((c, group_size), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((c, group_size), jnp.float32),
+        ),
+        interpret=interpret,
+    )(x2d)
+    mean = s1[0] / m_rows
+    e_xx = s2.reshape(num_groups, group_size, group_size) / m_rows
+    mg = mean.reshape(num_groups, group_size)
+    cov = e_xx - jnp.einsum("gc,gd->gcd", mg, mg)
+    return mean, cov
+
+
+# ------------------------------------------------------------- pass 2
+
+
+def _apply_kernel(x_ref, m_ref, w_ref, o_ref, *, g, compute_dtype):
+    x = x_ref[:]
+    xn = (x.astype(jnp.float32) - m_ref[:]).astype(compute_dtype)
+    tile_m, c = xn.shape
+    xg = xn.reshape(tile_m, c // g, g)
+    wg = w_ref[:].astype(compute_dtype).reshape(c // g, g, g)
+    # y_gd = Σ_c W_g[d, c] · xn_g[c] — the grouped 1x1 conv as a batched
+    # matmul (reference whitening.py:55).
+    y = jnp.einsum(
+        "mgc,gdc->mgd", xg, wg, preferred_element_type=jnp.float32
+    )
+    o_ref[:] = y.reshape(tile_m, c).astype(o_ref.dtype)
+
+
+def _apply_call(
+    x2d: jax.Array,
+    mean: jax.Array,
+    w: jax.Array,
+    interpret: bool,
+) -> jax.Array:
+    """``(x − m) @ Wᵀ`` per group; matmul in the activation dtype."""
+    m_rows, c = x2d.shape
+    g = w.shape[-1]
+    tile_m = min(_TILE_M, max(8, m_rows))
+    grid = (pl.cdiv(m_rows, tile_m),)
+    kernel = functools.partial(
+        _apply_kernel, g=g, compute_dtype=x2d.dtype
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((c, g), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_rows, c), x2d.dtype),
+        interpret=interpret,
+    )(x2d, mean.reshape(1, c).astype(jnp.float32), w.reshape(c, g))
+
+
+# ------------------------------------------------- differentiable train path
+
+
+def _pure_train_y(x2d, group_size, eps):
+    """XLA-op forward (y only) used for the recompute VJP.
+
+    Delegates to ``group_whiten`` itself (train-mode y is independent of
+    the incoming stats) so the backward can never drift from the XLA
+    path's numerics."""
+    from dwt_tpu.ops.whitening import group_whiten, init_whitening_stats
+
+    c = x2d.shape[-1]
+    y, _ = group_whiten(
+        x2d,
+        init_whitening_stats(c, group_size),
+        group_size=group_size,
+        train=True,
+        eps=eps,
+    )
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _train_whiten(x2d, group_size, eps, interpret):
+    num_groups, g = _resolve_groups(x2d.shape[-1], group_size)
+    mean, cov = _moments_call(x2d, num_groups, g, interpret)
+    w = whitening_matrix(_shrink(cov, eps))
+    y = _apply_call(x2d, mean, w, interpret)
+    return y, mean, cov
+
+
+def _train_whiten_fwd(x2d, group_size, eps, interpret):
+    out = _train_whiten(x2d, group_size, eps, interpret)
+    return out, (x2d,)
+
+
+def _train_whiten_bwd(group_size, eps, interpret, res, cots):
+    (x2d,) = res
+    gy, _, _ = cots  # mean/cov cotangents are zero (EMA is stop-gradient)
+    _, vjp = jax.vjp(lambda x: _pure_train_y(x, group_size, eps), x2d)
+    (dx,) = vjp(gy.astype(x2d.dtype))
+    return (dx,)
+
+
+_train_whiten.defvjp(_train_whiten_fwd, _train_whiten_bwd)
+
+
+# ------------------------------------------------------------- public op
+
+
+def pallas_group_whiten(
+    x: jax.Array,
+    stats: WhiteningStats,
+    *,
+    group_size: int,
+    train: bool,
+    momentum: float = 0.1,
+    eps: float = 1e-3,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, WhiteningStats]:
+    """Drop-in for :func:`dwt_tpu.ops.whitening.group_whiten` (single-chip).
+
+    Same semantics and state convention; no ``axis_name`` — under data
+    parallelism the moment pmean couples replicas, so sharded models keep
+    the XLA op (whose moments pmean inside shard_map).  ``interpret``
+    defaults to auto: compiled on TPU, interpreter elsewhere (tests).
+    """
+    if not HAS_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable in this jax build")
+    interpret = _auto_interpret() if interpret is None else interpret
+    num_features = x.shape[-1]
+    num_groups, g = _resolve_groups(num_features, group_size)
+    x2d = x.reshape(-1, num_features)
+
+    if train:
+        y2, mean, cov = _train_whiten(x2d, g, eps, interpret)
+        new_stats = WhiteningStats(
+            mean=(
+                momentum * lax.stop_gradient(mean)
+                + (1.0 - momentum) * stats.mean
+            ),
+            cov=(
+                momentum * lax.stop_gradient(cov)
+                + (1.0 - momentum) * stats.cov
+            ),
+        )
+        return y2.reshape(x.shape), new_stats
+
+    w = whitening_matrix(_shrink(stats.cov.astype(jnp.float32), eps))
+    y2 = _apply_call(x2d, stats.mean, w, interpret)
+    return y2.reshape(x.shape), stats

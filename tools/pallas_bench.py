@@ -1,0 +1,114 @@
+"""Whitening-op microbench: XLA `group_whiten` vs the Pallas kernels.
+
+Times the training-mode op (moments + factorize + apply, fwd only and
+fwd+bwd) at the flagship whitening-site shapes (PERF.md inventory) on the
+default backend.  This is the measurement that finalizes the Pallas
+go/no-go once the TPU is reachable; on CPU the Pallas path runs in
+interpreter mode, so CPU numbers validate plumbing, not performance —
+the JSON marks which.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python tools/pallas_bench.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Flagship whitening sites (PERF.md): (rows = N*H*W at batch 54, channels).
+SITES = {
+    "stem": (54 * 112 * 112, 64),
+    "layer1_bn3": (54 * 56 * 56, 256),
+}
+
+
+def _time(fn, *args, steps=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--rows_cap", type=int, default=None,
+                    help="cap site rows (CPU plumbing runs)")
+    ap.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dwt_tpu.ops import (
+        group_whiten,
+        init_whitening_stats,
+        pallas_group_whiten,
+    )
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+    for site, (rows, c) in SITES.items():
+        if args.rows_cap:
+            rows = min(rows, args.rows_cap)
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(rows, c)), dtype
+        )
+        stats = init_whitening_stats(c, 4)
+
+        def xla_fwd(x):
+            y, _ = group_whiten(x, stats, group_size=4, train=True)
+            return y
+
+        def pal_fwd(x):
+            y, _ = pallas_group_whiten(
+                x, stats, group_size=4, train=True, interpret=interpret
+            )
+            return y
+
+        record = {
+            "site": site,
+            "rows": rows,
+            "channels": c,
+            "dtype": args.dtype,
+            "backend": backend,
+            "pallas_interpret_mode": interpret,
+        }
+        record["xla_fwd_ms"] = round(
+            _time(jax.jit(xla_fwd), x, steps=args.steps) * 1e3, 3
+        )
+        record["pallas_fwd_ms"] = round(
+            _time(jax.jit(pal_fwd), x, steps=args.steps) * 1e3, 3
+        )
+
+        def xla_step(x):
+            return jax.value_and_grad(lambda x: jnp.sum(xla_fwd(x) ** 2))(x)
+
+        def pal_step(x):
+            return jax.value_and_grad(lambda x: jnp.sum(pal_fwd(x) ** 2))(x)
+
+        record["xla_fwdbwd_ms"] = round(
+            _time(jax.jit(xla_step), x, steps=args.steps) * 1e3, 3
+        )
+        record["pallas_fwdbwd_ms"] = round(
+            _time(jax.jit(pal_step), x, steps=args.steps) * 1e3, 3
+        )
+        record["fwd_speedup"] = round(
+            record["xla_fwd_ms"] / max(record["pallas_fwd_ms"], 1e-9), 3
+        )
+        print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()
