@@ -103,7 +103,8 @@ def _bench_lenet(steps: int, batch: int):
     return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
 
 
-def _bench_resnet50(steps: int, batch: int, image: int = 224):
+def _bench_resnet50(steps: int, batch: int, image: int = 224,
+                    use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -128,7 +129,10 @@ def _bench_resnet50(steps: int, batch: int, image: int = 224):
             rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
         ),
     }
-    model = ResNetDWT.resnet50(num_classes=65, group_size=4, dtype=jnp.bfloat16)
+    model = ResNetDWT.resnet50(
+        num_classes=65, group_size=4, dtype=jnp.bfloat16,
+        use_pallas=use_pallas,
+    )
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
@@ -327,6 +331,8 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         # reduced resolution and batch keep the full ResNet50-DWT step at
         # ~6.5 s on one CPU core (~45 s compile; ~100 s child total).
         model_args = ["--model", "resnet50", "--image", "96", "--batch", "4"]
+        if args.pallas:  # keep the requested A/B variant in the fallback
+            model_args.append("--pallas")
         steps = min(args.steps, 5)
     cmd = [
         sys.executable,
@@ -361,12 +367,20 @@ def main():
         help="resnet50 input resolution (the CPU fallback uses 96)",
     )
     ap.add_argument(
+        "--pallas",
+        action="store_true",
+        help="resnet50 with the Pallas whitening kernels — run both ways "
+        "on TPU to decide PERF.md's go/no-go at full-step level",
+    )
+    ap.add_argument(
         "--no-probe",
         action="store_true",
         help="skip the subprocess backend probe (fallback path)",
     )
     ap.add_argument("--fallback-note", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.pallas and args.model != "resnet50":
+        ap.error("--pallas only applies to --model resnet50")
 
     if not args.no_probe:
         # Cheap TCP poll first: when the tunnel is down the gRPC client
@@ -406,13 +420,15 @@ def main():
     else:
         batch = args.batch or 18
         imgs_per_sec, step_time, flops = _bench_resnet50(
-            args.steps, batch, args.image
+            args.steps, batch, args.image, use_pallas=args.pallas
         )
         metric = (
             "resnet50_dwt_train_imgs_per_sec"
             if args.image == 224
             else f"resnet50_dwt_{args.image}px_train_imgs_per_sec"
         )
+        if args.pallas:
+            metric += "_pallas"
 
     flops_source = "xla_cost_analysis"
     if flops is None:

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distributed", action="store_true",
                    help="multi-host bring-up: call jax.distributed.initialize(); "
                         "launch the same command on every host")
+    p.add_argument("--pallas_whiten", action="store_true",
+                   help="route whitening through the Pallas two-pass "
+                        "kernels (single-chip; incompatible with "
+                        "--data_parallel)")
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")

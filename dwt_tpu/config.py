@@ -42,6 +42,7 @@ class DigitsConfig:
     data_parallel: bool = False  # shard over all local devices
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
+    pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     bf16: bool = False
@@ -83,6 +84,7 @@ class OfficeHomeConfig:
     data_parallel: bool = False
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
+    pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
     bf16: bool = False

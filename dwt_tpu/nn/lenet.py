@@ -46,6 +46,7 @@ class LeNetDWT(fnn.Module):
     whiten_eps: float = 1e-3
     axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False  # Pallas whitening kernels (single-chip)
 
     def _norm(self, x, norm, train):
         return apply_domain_norm(x, norm, train, self.num_domains)
@@ -76,7 +77,8 @@ class LeNetDWT(fnn.Module):
         x = self._norm(
             x,
             DomainWhiten(
-                32, self.group_size, eps=self.whiten_eps, name="dn1", **norm_kw
+                32, self.group_size, eps=self.whiten_eps, name="dn1",
+                use_pallas=self.use_pallas, **norm_kw
             ),
             train,
         )
@@ -88,7 +90,8 @@ class LeNetDWT(fnn.Module):
         x = self._norm(
             x,
             DomainWhiten(
-                48, self.group_size, eps=self.whiten_eps, name="dn2", **norm_kw
+                48, self.group_size, eps=self.whiten_eps, name="dn2",
+                use_pallas=self.use_pallas, **norm_kw
             ),
             train,
         )

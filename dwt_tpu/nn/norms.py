@@ -79,9 +79,19 @@ class DomainWhiten(fnn.Module):
     eps: float = 1e-3
     use_affine: bool = True
     axis_name: Optional[AxisName] = None
+    # Route through the Pallas two-pass kernels (ops/pallas_whitening.py).
+    # Single-chip only: the kernel has no cross-replica moment pmean, so it
+    # cannot be combined with ``axis_name`` (data parallelism).
+    use_pallas: bool = False
 
     @fnn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        if self.use_pallas and self.axis_name is not None:
+            raise ValueError(
+                "DomainWhiten(use_pallas=True) is single-chip: the Pallas "
+                "kernel computes local moments only and cannot reproduce "
+                "the cross-replica pmean that axis_name requires"
+            )
         proto = init_whitening_stats(self.features, self.group_size)
         stats_var = self.variable(
             "batch_stats",
@@ -94,26 +104,58 @@ class DomainWhiten(fnn.Module):
 
         if train:
             _check_train_input(x, self.num_domains, self.name or "DomainWhiten")
-            whiten = partial(
-                group_whiten,
-                group_size=self.group_size,
-                train=True,
-                momentum=self.momentum,
-                eps=self.eps,
-                axis_name=self.axis_name,
-            )
-            y, new_stats = jax.vmap(whiten)(x, stats)
+            if self.use_pallas:
+                from dwt_tpu.ops.pallas_whitening import pallas_group_whiten
+
+                # Static unrolled domain loop (D is 2-3): pallas_call +
+                # custom_vjp compose more robustly unrolled than vmapped.
+                outs = [
+                    pallas_group_whiten(
+                        x[d],
+                        jax.tree.map(lambda a, d=d: a[d], stats),
+                        group_size=self.group_size,
+                        train=True,
+                        momentum=self.momentum,
+                        eps=self.eps,
+                    )
+                    for d in range(self.num_domains)
+                ]
+                y = jnp.stack([o[0] for o in outs])
+                new_stats = jax.tree.map(
+                    lambda *leaves: jnp.stack(leaves), *[o[1] for o in outs]
+                )
+            else:
+                whiten = partial(
+                    group_whiten,
+                    group_size=self.group_size,
+                    train=True,
+                    momentum=self.momentum,
+                    eps=self.eps,
+                    axis_name=self.axis_name,
+                )
+                y, new_stats = jax.vmap(whiten)(x, stats)
             if not self.is_initializing():
                 stats_var.value = new_stats
         else:
             branch = jax.tree.map(lambda a: a[self.eval_domain], stats)
-            y, _ = group_whiten(
-                x,
-                branch,
-                group_size=self.group_size,
-                train=False,
-                eps=self.eps,
-            )
+            if self.use_pallas:
+                from dwt_tpu.ops.pallas_whitening import pallas_group_whiten
+
+                y, _ = pallas_group_whiten(
+                    x,
+                    branch,
+                    group_size=self.group_size,
+                    train=False,
+                    eps=self.eps,
+                )
+            else:
+                y, _ = group_whiten(
+                    x,
+                    branch,
+                    group_size=self.group_size,
+                    train=False,
+                    eps=self.eps,
+                )
 
         if self.use_affine:
             gamma = self.param(

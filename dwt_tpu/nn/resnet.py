@@ -58,6 +58,7 @@ class BottleneckDWT(fnn.Module):
     momentum: float = 0.1
     axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False  # Pallas whitening kernels (single-chip)
 
     expansion: int = 4
 
@@ -70,7 +71,9 @@ class BottleneckDWT(fnn.Module):
             name=name,
         )
         if self.use_whitening:
-            return DomainWhiten(features, self.group_size, **kw)
+            return DomainWhiten(
+                features, self.group_size, use_pallas=self.use_pallas, **kw
+            )
         return DomainBatchNorm(features, **kw)
 
     @fnn.compact
@@ -135,6 +138,7 @@ class ResNetDWT(fnn.Module):
     # (jax.checkpoint): trades ~1/3 more FLOPs for not storing block
     # activations — the standard HBM lever for larger per-chip batches.
     remat: bool = False
+    use_pallas: bool = False  # Pallas whitening kernels (single-chip)
 
     @classmethod
     def resnet50(cls, **kw) -> "ResNetDWT":
@@ -178,7 +182,9 @@ class ResNetDWT(fnn.Module):
         )
         x = apply_domain_norm(
             x,
-            DomainWhiten(64, self.group_size, **stem_kw)
+            DomainWhiten(
+                64, self.group_size, use_pallas=self.use_pallas, **stem_kw
+            )
             if self.whiten
             else DomainBatchNorm(64, **stem_kw),
             train,
@@ -209,6 +215,7 @@ class ResNetDWT(fnn.Module):
                     momentum=self.momentum,
                     axis_name=self.axis_name,
                     dtype=self.dtype,
+                    use_pallas=self.use_pallas,
                     name=f"layer{stage}_{block}",
                 )(x, train)
 

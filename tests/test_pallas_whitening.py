@@ -131,3 +131,59 @@ def test_pallas_jit_composes():
     assert y.shape == x.shape
     assert np.isfinite(np.asarray(y)).all()
     assert not np.allclose(np.asarray(new_stats.cov), 1.0)
+
+
+def test_model_level_pallas_parity():
+    """use_pallas routes every DomainWhiten site through the kernels; the
+    dual-branch LeNet must produce matching logits, gradients, and EMA'd
+    stats either way (interpret mode on CPU)."""
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import create_train_state, make_digits_train_step
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "source_x": jnp.asarray(rng.normal(size=(4, 28, 28, 1)), jnp.float32),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(4,))),
+        "target_x": jnp.asarray(rng.normal(size=(4, 28, 28, 1)), jnp.float32),
+    }
+    sample = jnp.stack([batch["source_x"], batch["target_x"]])
+    tx = optax.sgd(1e-2)
+
+    states, metrics = [], []
+    for use_pallas in (False, True):
+        model = LeNetDWT(group_size=4, use_pallas=use_pallas)
+        state = create_train_state(model, jax.random.key(0), sample, tx)
+        step = jax.jit(make_digits_train_step(model, tx, 0.1))
+        for _ in range(2):
+            state, m = step(state, batch)
+        states.append(state)
+        metrics.append(m)
+
+    for k in metrics[0]:
+        np.testing.assert_allclose(
+            float(metrics[1][k]), float(metrics[0][k]), rtol=1e-4, atol=1e-5
+        )
+    for a, b in zip(
+        jax.tree.leaves(states[0].params), jax.tree.leaves(states[1].params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+    for a, b in zip(
+        jax.tree.leaves(states[0].batch_stats),
+        jax.tree.leaves(states[1].batch_stats),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+
+def test_pallas_rejects_data_parallel_axis():
+    from dwt_tpu.nn import DomainWhiten
+
+    model = DomainWhiten(8, 4, axis_name="data", use_pallas=True)
+    x = jnp.zeros((2, 4, 8))
+    with pytest.raises(ValueError, match="single-chip"):
+        model.init(jax.random.key(0), x, train=True)
