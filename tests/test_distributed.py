@@ -70,6 +70,10 @@ def test_two_process_distributed_digits(tmp_path):
                     "--test_batch_size", "8",
                     "--num_workers", "0",
                     "--metrics_jsonl", jsonl,
+                    # SHARED dir (the real-pod layout): orbax must
+                    # coordinate one ocdbt artifact across both ranks.
+                    "--ckpt_dir", str(tmp_path / "shared_ck"),
+                    "--ckpt_every_epochs", "1",
                 ],
                 env=env,
                 stdout=subprocess.PIPE,
@@ -106,3 +110,11 @@ def test_two_process_distributed_digits(tmp_path):
 
     # Both processes trained the same number of steps (no ragged tail).
     assert _last(rec0, "test")["step"] == _last(rec1, "test")["step"] > 0
+
+    # The coordinated multi-host checkpoint exists as ONE artifact with
+    # both processes' ocdbt shards.
+    step = _last(rec0, "test")["step"]
+    ck = tmp_path / "shared_ck" / str(step)
+    assert ck.is_dir(), f"no coordinated checkpoint at {ck}"
+    assert (ck / "ocdbt.process_0").exists()
+    assert (ck / "ocdbt.process_1").exists()
