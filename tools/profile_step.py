@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_step(model_name: str, batch: int, image: int, group_size: int,
-               whiten: bool = True, remat: bool = False):
+               whiten: bool = True, remat: bool = False,
+               use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -59,7 +60,7 @@ def build_step(model_name: str, batch: int, image: int, group_size: int,
         "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
     }[model_name]
     model = ctor(num_classes=65, group_size=group_size, dtype=jnp.bfloat16,
-                 whiten=whiten, remat=remat)
+                 whiten=whiten, remat=remat, use_pallas=use_pallas)
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
@@ -95,6 +96,10 @@ def main():
                     help="profile the rematerialized (jax.checkpoint) "
                          "variant — measures the HBM-for-FLOPs tradeoff "
                          "behind the training CLIs' --remat flag")
+    ap.add_argument("--pallas", action="store_true",
+                    help="profile with the Pallas whitening kernels — "
+                         "pair with a plain run for the full-step A/B "
+                         "behind PERF.md's go/no-go")
     args = ap.parse_args()
 
     out = {
@@ -106,8 +111,10 @@ def main():
     }
 
     step, state, b = build_step(args.model, args.batch, args.image,
-                                args.group_size, remat=args.remat)
+                                args.group_size, remat=args.remat,
+                                use_pallas=args.pallas)
     out["remat"] = args.remat
+    out["pallas"] = args.pallas
     compiled, total_flops, _ = flops_of(step, state, b)
     out["flops_per_step"] = total_flops
 
