@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")
+    p.add_argument("--init_ckpt", type=str, default=None,
+                   help="read-only Orbax init artifact (written by "
+                        "dwt-convert); unlike --ckpt_dir it is never "
+                        "written to, so repeated runs always start from "
+                        "the converted weights")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_iters", type=int, default=d.ckpt_every_iters)
     p.add_argument("--bf16", action="store_true")

@@ -85,6 +85,7 @@ class OfficeHomeConfig:
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
+    init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
     bf16: bool = False

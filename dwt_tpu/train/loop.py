@@ -45,7 +45,7 @@ from dwt_tpu.data import (
     random_affine,
 )
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
-from dwt_tpu.train.optim import adam_l2, multistep_schedule, sgd_two_group
+from dwt_tpu.train.optim import adam_l2, multistep_schedule, officehome_tx
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
     make_digits_train_step,
@@ -536,13 +536,7 @@ def run_officehome(
     bs = cfg.source_batch_size  # target loader uses source bs too (:565)
     local_bs, shard = _multihost_data_split(cfg, bs)
 
-    head_lr = multistep_schedule(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
-    backbone_lr = multistep_schedule(
-        cfg.lr * cfg.backbone_lr_scale, cfg.lr_milestones, cfg.lr_gamma
-    )
-    tx = sgd_two_group(
-        head_lr, backbone_lr, cfg.sgd_momentum, cfg.weight_decay
-    )
+    tx = officehome_tx(cfg)
 
     def build_model(axis_name=None):
         ctors = {
@@ -569,7 +563,16 @@ def run_officehome(
         build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
     )
 
-    if cfg.resnet_path and not cfg.synthetic:
+    # Init priority when NOT resuming a crashed/finished run: a converted
+    # Orbax artifact (--init_ckpt, read-only — see dwt-convert) beats the
+    # inline torch conversion (--resnet_path). A resume checkpoint in
+    # --ckpt_dir supersedes both below.
+    resuming = cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None
+    if cfg.init_ckpt and not resuming:
+        state = restore_state(cfg.init_ckpt, state)
+        state = state.replace(step=jnp.zeros_like(state.step))
+        logger.log("init_ckpt", 0, detail=cfg.init_ckpt)
+    elif cfg.resnet_path and not cfg.synthetic and not resuming:
         if os.path.exists(cfg.resnet_path):
             from dwt_tpu.convert import (
                 convert_resnet_state_dict,

@@ -84,3 +84,19 @@ def sgd_two_group(
     return optax.multi_transform(
         {"head": sgd(head_lr), "backbone": sgd(backbone_lr)}, label_fn
     )
+
+
+def officehome_tx(cfg) -> optax.GradientTransformation:
+    """The OfficeHome/VisDA optimizer exactly as the training loop builds
+    it — multistep-scheduled two-group SGD.  The SINGLE constructor shared
+    by ``run_officehome`` and ``dwt-convert``: both must produce the same
+    opt-state pytree STRUCTURE or converted artifacts stop being
+    restorable by the loop (scheduled lrs carry ScaleByScheduleState;
+    constants do not)."""
+    head_lr = multistep_schedule(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
+    backbone_lr = multistep_schedule(
+        cfg.lr * cfg.backbone_lr_scale, cfg.lr_milestones, cfg.lr_gamma
+    )
+    return sgd_two_group(
+        head_lr, backbone_lr, cfg.sgd_momentum, cfg.weight_decay
+    )

@@ -147,3 +147,48 @@ def test_full_resnet50_checkpoint_converts(tmp_path):
     # fc_out kept its fresh (trainable) init — reference trains it from
     # scratch at the head lr (:578-590).
     assert params["fc_out"]["kernel"].shape == (2048, 65)
+
+
+@pytest.mark.slow
+def test_convert_cli_then_init_ckpt_flow(tmp_path):
+    """dwt-convert: one-shot torch->Orbax conversion that the OfficeHome
+    CLI then consumes read-only via --init_ckpt (the repeated-runs flow —
+    --ckpt_dir stays the run's own save/resume dir)."""
+    import json
+
+    from dwt_tpu.cli.convert import main as convert_main
+    from dwt_tpu.cli.officehome import main as oh_main
+    from dwt_tpu.utils import latest_step
+
+    rng = np.random.default_rng(0)
+    sd = _synth_state_dict(rng)
+    ckpt = tmp_path / "model_best_gr_4.pth.tar"
+    torch.save(
+        {"state_dict": {f"module.{k}": torch.from_numpy(np.asarray(v))
+                        for k, v in sd.items()}},
+        str(ckpt),
+    )
+    out_dir = str(tmp_path / "orbax_init")
+    assert convert_main(["--torch_ckpt", str(ckpt), "--out_dir", out_dir]) == 0
+    assert latest_step(out_dir) == 0
+
+    # Drive the real consumer: full resnet50 at reduced resolution, one
+    # iteration, starting from the converted artifact.
+    jsonl = tmp_path / "m.jsonl"
+    acc = oh_main(
+        [
+            "--synthetic", "--synthetic_size", "6",
+            "--arch", "resnet50", "--img_crop_size", "96",
+            "--num_classes", "65",
+            "--source_batch_size", "3", "--test_batch_size", "3",
+            "--num_iters", "1", "--check_acc_step", "10",
+            "--stat_collection_passes", "0", "--group_size", "4",
+            "--init_ckpt", out_dir,
+            "--metrics_jsonl", str(jsonl),
+        ]
+    )
+    assert 0.0 <= acc <= 100.0
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert "init_ckpt" in kinds  # the converted weights were loaded
+    assert "checkpoint_convert" not in kinds  # inline torch path skipped
