@@ -1,0 +1,82 @@
+"""Tier-5 (SURVEY §4.5): end-to-end paper-accuracy reproduction.
+
+These tests SKIP unless the real datasets are present (no dataset ships
+in this environment) — they are the turnkey harness for the day they
+are: point the env vars at the data, fill ``baselines/`` from the paper
+PDF, and the suite itself produces the ±0.3% verdicts
+(``BASELINE.json`` north star).
+
+Env contract:
+
+* ``DWT_DIGITS_ROOT``    — dir containing ``usps/usps_28x28.pkl`` and
+  ``mnist/`` (torchvision-processed or raw idx files);
+* ``DWT_OFFICEHOME_ROOT`` — ``OfficeHomeDataset_10072016`` dir with the
+  four domain subdirs;
+* ``DWT_RESNET_CKPT``     — ``model_best_gr_4.pth.tar``.
+
+Expected accuracies come from ``baselines/*.json``; a ``null`` entry
+(template not yet filled from the PDF) skips that assertion with an
+explicit reason rather than passing vacuously.
+"""
+
+import os
+
+import pytest
+
+from dwt_tpu.utils import load_expect_table
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _expect(table: str, key: str) -> float:
+    value = load_expect_table(os.path.join(ROOT, "baselines", table)).get(key)
+    if value is None:
+        pytest.skip(
+            f"baselines/{table}:{key} is null — fill it from the paper PDF"
+        )
+    return value
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("DWT_DIGITS_ROOT"),
+    reason="real digits data not present (set DWT_DIGITS_ROOT)",
+)
+@pytest.mark.parametrize("source,target,key", [
+    ("usps", "mnist", "usps->mnist"),
+    ("mnist", "usps", "mnist->usps"),
+])
+def test_digits_paper_accuracy(source, target, key):
+    from dwt_tpu.cli.usps_mnist import main
+
+    expected = _expect("digits.json", key)
+    # main() raises SystemExit(1) itself when outside the band — the
+    # reference recipe verbatim (README.md:19: group_size 4, 120 epochs).
+    acc = main([
+        "--source", source, "--target", target,
+        "--group_size", "4",
+        "--data_root", os.environ["DWT_DIGITS_ROOT"],
+        "--expect_accuracy", str(expected), "--tolerance", "0.3",
+    ])
+    assert abs(acc - expected) <= 0.3
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not (os.environ.get("DWT_OFFICEHOME_ROOT")
+         and os.environ.get("DWT_RESNET_CKPT")),
+    reason="OfficeHome data / checkpoint not present "
+    "(set DWT_OFFICEHOME_ROOT and DWT_RESNET_CKPT)",
+)
+def test_officehome_art_clipart_paper_accuracy():
+    from dwt_tpu.cli.officehome import main
+
+    expected = _expect("officehome_table3.json", "Art->Clipart")
+    root = os.environ["DWT_OFFICEHOME_ROOT"]
+    acc = main([
+        "--s_dset_path", os.path.join(root, "Art"),
+        "--t_dset_path", os.path.join(root, "Clipart"),
+        "--resnet_path", os.environ["DWT_RESNET_CKPT"],
+        "--expect_accuracy", str(expected), "--tolerance", "0.3",
+    ])
+    assert abs(acc - expected) <= 0.3
