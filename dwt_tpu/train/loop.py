@@ -275,13 +275,16 @@ def _evaluate(
     process and sum the counters across processes (the cross-replica sum
     of the reference ``test()`` accumulators, SURVEY §5)."""
     loss_sum, correct, count = 0.0, 0, 0
-    for x, y in batch_iterator(
-        dataset, batch_size, shuffle=False, drop_last=False,
-        shard=_process_shard(), num_workers=num_workers,
+    # Prefetch overlaps host batch assembly + transfer with the device's
+    # previous eval step (same double-buffering as the train loops).
+    for x, y in prefetch_to_device(
+        batch_iterator(
+            dataset, batch_size, shuffle=False, drop_last=False,
+            shard=_process_shard(), num_workers=num_workers,
+        ),
+        size=2,
     ):
-        out = eval_step(
-            state.params, state.batch_stats, jnp.asarray(x), jnp.asarray(y)
-        )
+        out = eval_step(state.params, state.batch_stats, x, y)
         loss_sum += float(out["loss_sum"])
         correct += int(out["correct"])
         count += int(out["count"])
@@ -691,11 +694,14 @@ def run_officehome(
         # seed/epoch vary the per-item augmentation tokens so each pass
         # draws fresh crops — N identical passes would defeat the
         # stat-re-estimation protocol (resnet50…py:380-389).
-        for x, _ in batch_iterator(
-            test_ds, cfg.test_batch_size, shuffle=False, drop_last=False,
-            seed=cfg.seed, epoch=p, num_workers=cfg.num_workers,
+        for x, _ in prefetch_to_device(
+            batch_iterator(
+                test_ds, cfg.test_batch_size, shuffle=False, drop_last=False,
+                seed=cfg.seed, epoch=p, num_workers=cfg.num_workers,
+            ),
+            size=2,
         ):
-            state = collect_step(state, jnp.asarray(x))
+            state = collect_step(state, x)
         logger.log("stat_collection", int(state.step), pass_index=p)
     result = _evaluate(
         eval_step, state, test_ds, cfg.test_batch_size,

@@ -29,7 +29,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _expect(table: str, key: str) -> float:
-    value = load_expect_table(os.path.join(ROOT, "baselines", table)).get(key)
+    # [key] not .get(): a typo'd/renamed key must FAIL (KeyError), not
+    # skip forever with a misleading "is null" reason.
+    value = load_expect_table(os.path.join(ROOT, "baselines", table))[key]
     if value is None:
         pytest.skip(
             f"baselines/{table}:{key} is null — fill it from the paper PDF"
@@ -50,15 +52,15 @@ def test_digits_paper_accuracy(source, target, key):
     from dwt_tpu.cli.usps_mnist import main
 
     expected = _expect("digits.json", key)
-    # main() raises SystemExit(1) itself when outside the band — the
-    # reference recipe verbatim (README.md:19: group_size 4, 120 epochs).
+    # No --expect_accuracy here: the assert below reports actual-vs-
+    # expected on failure, where the CLI gate would die as a bare
+    # SystemExit(1). Recipe verbatim (README.md:19: group_size 4).
     acc = main([
         "--source", source, "--target", target,
         "--group_size", "4",
         "--data_root", os.environ["DWT_DIGITS_ROOT"],
-        "--expect_accuracy", str(expected), "--tolerance", "0.3",
     ])
-    assert abs(acc - expected) <= 0.3
+    assert abs(acc - expected) <= 0.3, (acc, expected)
 
 
 @pytest.mark.slow
@@ -77,6 +79,5 @@ def test_officehome_art_clipart_paper_accuracy():
         "--s_dset_path", os.path.join(root, "Art"),
         "--t_dset_path", os.path.join(root, "Clipart"),
         "--resnet_path", os.environ["DWT_RESNET_CKPT"],
-        "--expect_accuracy", str(expected), "--tolerance", "0.3",
     ])
-    assert abs(acc - expected) <= 0.3
+    assert abs(acc - expected) <= 0.3, (acc, expected)
