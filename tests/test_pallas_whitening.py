@@ -133,10 +133,12 @@ def test_pallas_jit_composes():
     assert not np.allclose(np.asarray(new_stats.cov), 1.0)
 
 
-def test_model_level_pallas_parity():
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_model_level_pallas_parity(dtype):
     """use_pallas routes every DomainWhiten site through the kernels; the
     dual-branch LeNet must produce matching logits, gradients, and EMA'd
-    stats either way (interpret mode on CPU)."""
+    stats either way (interpret mode on CPU), in f32 and in the bf16
+    mixed-precision config the TPU recipe uses."""
     import optax
 
     from dwt_tpu.nn import LeNetDWT
@@ -153,7 +155,7 @@ def test_model_level_pallas_parity():
 
     states, metrics = [], []
     for use_pallas in (False, True):
-        model = LeNetDWT(group_size=4, use_pallas=use_pallas)
+        model = LeNetDWT(group_size=4, use_pallas=use_pallas, dtype=dtype)
         state = create_train_state(model, jax.random.key(0), sample, tx)
         step = jax.jit(make_digits_train_step(model, tx, 0.1))
         for _ in range(2):
@@ -161,22 +163,32 @@ def test_model_level_pallas_parity():
         states.append(state)
         metrics.append(m)
 
+    metric_tol = (
+        dict(rtol=1e-4, atol=1e-5)
+        if dtype == jnp.float32
+        else dict(rtol=2e-2, atol=2e-2)  # bf16 activation resolution
+    )
     for k in metrics[0]:
         np.testing.assert_allclose(
-            float(metrics[1][k]), float(metrics[0][k]), rtol=1e-4, atol=1e-5
+            float(metrics[1][k]), float(metrics[0][k]), **metric_tol
         )
+    tree_tol = (
+        dict(rtol=1e-3, atol=1e-5)
+        if dtype == jnp.float32
+        else dict(rtol=2e-2, atol=2e-3)  # bf16 rounding in activations
+    )
     for a, b in zip(
         jax.tree.leaves(states[0].params), jax.tree.leaves(states[1].params)
     ):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+            np.asarray(a, np.float32), np.asarray(b, np.float32), **tree_tol
         )
     for a, b in zip(
         jax.tree.leaves(states[0].batch_stats),
         jax.tree.leaves(states[1].batch_stats),
     ):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+            np.asarray(a, np.float32), np.asarray(b, np.float32), **tree_tol
         )
 
 
