@@ -34,7 +34,9 @@ import time
 # First real-TPU measurement anchors vs_baseline; None -> vs_baseline=1.0.
 # The anchor is ONLY comparable to runs of the same metric (flagship
 # resnet50 at 224px) — other model/resolution records report vs_baseline=1.
-BASELINE_IMGS_PER_SEC = None
+# Anchor: round-4 first honest TPU v5e number (2026-07-29), 94.8 ms/step,
+# MFU 0.070, fetch-synchronized two-point timing.
+BASELINE_IMGS_PER_SEC = 569.64
 BASELINE_METRIC = "resnet50_dwt_train_imgs_per_sec"
 
 _RELAY_VAR = "PALLAS_AXON_POOL_IPS"
@@ -165,24 +167,63 @@ def _compile_with_flops(step, state, batch):
     return compiled, flops
 
 
+def two_point_per_step(step, state, batch, steps, warmup=3):
+    """Fetch-synchronized two-point per-step timing.
+
+    Synchronizes by FETCHING a scalar, not ``block_until_ready``: through
+    the axon relay ``block_until_ready`` resolves the local handle without
+    waiting for remote execution (measured: a chained-matmul loop
+    "finished" at 300x the chip's peak FLOP/s).  A host fetch of the loss
+    forces the whole donated-state chain to execute everywhere.  The
+    two-point form ``per_step = (t(n2) - t(n1)) / (n2 - n1)`` cancels the
+    fixed per-fetch relay round-trip (~60-70 ms measured) that would
+    otherwise dominate short runs.  Shared by bench.py and
+    tools/profile_step.py so the two tools report comparable numbers.
+
+    Returns ``(per_step_seconds, state, last_loss, degraded)`` —
+    ``degraded`` is True when the two-point difference was non-positive
+    (timing jitter on very fast steps) and the returned value is the
+    single-run average, which re-includes the fetch round-trip.
+    """
+
+    def run(n, state):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step(state, batch)
+        loss = float(m["loss"])
+        return time.perf_counter() - t0, state, loss
+
+    # Warmup steady-state steps (compile already done when AOT worked).
+    _, state, _ = run(warmup, state)
+    n1 = max(1, steps // 4)
+    n2 = max(steps, n1 + 4)
+    dt1, state, _ = run(n1, state)
+    dt2, state, loss = run(n2, state)
+    per_step = (dt2 - dt1) / (n2 - n1)
+    degraded = per_step <= 0
+    if degraded:
+        # Timing noise on very fast steps: fall back to the single-run
+        # average, which RE-INCLUDES the fetch round-trip — callers must
+        # surface ``degraded`` so the record is not read as a clean
+        # two-point measurement.
+        per_step = dt2 / n2
+        print(
+            "bench: two-point timing degenerate (dt2<=dt1); reporting "
+            "single-run average INCLUDING the fetch round-trip",
+            file=sys.stderr,
+        )
+    return per_step, state, loss, degraded
+
+
 def _time_steps(step, state, batch, steps, imgs_per_step):
-    import jax
     import numpy as np
 
     step, flops_per_step = _compile_with_flops(step, state, batch)
-    # Warmup: 3 steady-state steps (compile already done when AOT worked).
-    state, m = step(state, batch)
-    jax.block_until_ready(m)
-    for _ in range(2):
-        state, m = step(state, batch)
-    jax.block_until_ready(m)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, batch)
-    jax.block_until_ready(m)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(float(m["loss"])), "non-finite loss in bench"
-    return imgs_per_step * steps / dt, dt / steps, flops_per_step
+    per_step, state, loss, degraded = two_point_per_step(
+        step, state, batch, steps
+    )
+    assert np.isfinite(loss), "non-finite loss in bench"
+    return imgs_per_step / per_step, per_step, flops_per_step, degraded
 
 
 def _relay_endpoints():
@@ -415,11 +456,13 @@ def main():
 
     if args.model == "lenet":
         batch = args.batch or 32
-        imgs_per_sec, step_time, flops = _bench_lenet(args.steps, batch)
+        imgs_per_sec, step_time, flops, degraded = _bench_lenet(
+            args.steps, batch
+        )
         metric = "lenet_dwt_train_imgs_per_sec"
     else:
         batch = args.batch or 18
-        imgs_per_sec, step_time, flops = _bench_resnet50(
+        imgs_per_sec, step_time, flops, degraded = _bench_resnet50(
             args.steps, batch, args.image, use_pallas=args.pallas
         )
         metric = (
@@ -445,11 +488,15 @@ def main():
     if peak is not None and flops:
         mfu = flops / step_time / peak
 
-    # Only normalize runs of the anchored metric — a 96px CPU fallback
-    # divided by a 224px TPU anchor would be a meaningless ratio.
+    # Only normalize runs comparable to the anchored workload — the
+    # flagship 224px metric and its --pallas A/B twin (same model, same
+    # shapes, different whitening lowering: the one ratio PERF.md's
+    # go/no-go needs).  A 96px CPU fallback divided by a 224px TPU anchor
+    # would be a meaningless ratio.
+    anchored = metric in (BASELINE_METRIC, BASELINE_METRIC + "_pallas")
     vs = (
         imgs_per_sec / BASELINE_IMGS_PER_SEC
-        if BASELINE_IMGS_PER_SEC is not None and metric == BASELINE_METRIC
+        if BASELINE_IMGS_PER_SEC is not None and anchored
         else 1.0
     )
     record = {
@@ -458,14 +505,22 @@ def main():
         "unit": "imgs/sec",
         "vs_baseline": round(vs, 4),
         # The anchor travels with the record so rounds stay comparable
-        # without reading source (None until the first real TPU number).
-        "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
+        # without reading source (null when this record's metric is not
+        # anchored — a 96px/lenet value vs the 224px anchor would be a
+        # meaningless ratio).
+        "baseline_imgs_per_sec": (
+            BASELINE_IMGS_PER_SEC if anchored else None
+        ),
         "step_time_ms": round(step_time * 1e3, 3),
         "mfu": None if mfu is None else round(mfu, 4),
         "flops_per_step": flops,
         "flops_source": flops_source,
         "backend": jax.default_backend(),
         "device_kind": device_kind,
+        # two_point = fetch-synchronized relay-RTT-cancelling timing;
+        # single_run_with_rtt = degenerate fallback that re-includes the
+        # fetch round-trip (fast steps + timing jitter).
+        "timing": "single_run_with_rtt" if degraded else "two_point",
     }
     if args.model == "resnet50":
         record["image_size"] = args.image

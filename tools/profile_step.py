@@ -22,9 +22,10 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _compile_with_flops, two_point_per_step  # noqa: E402
 
 
 def build_step(model_name: str, batch: int, image: int, group_size: int,
@@ -68,14 +69,6 @@ def build_step(model_name: str, batch: int, image: int, group_size: int,
     return step, state, b
 
 
-def flops_of(step, state, batch):
-    compiled = step.lower(state, batch).compile()
-    analysis = compiled.cost_analysis()
-    if isinstance(analysis, (list, tuple)):
-        analysis = analysis[0]
-    return compiled, float(analysis.get("flops", 0.0)), analysis
-
-
 def main():
     import jax
 
@@ -87,7 +80,8 @@ def main():
     ap.add_argument("--group_size", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--trace", default=None,
-                    help="directory for a jax.profiler trace of the timed loop")
+                    help="directory for a jax.profiler trace of a short "
+                         "steady-state run (5 steps, after timing)")
     ap.add_argument("--ablate", action="store_true",
                     help="also build + time the whitening-ablated twin "
                          "(every norm site a BN) and report the whitening "
@@ -115,33 +109,35 @@ def main():
                                 use_pallas=args.pallas)
     out["remat"] = args.remat
     out["pallas"] = args.pallas
-    compiled, total_flops, _ = flops_of(step, state, b)
+    # Guarded AOT compile (falls back to the jitted step when the relay
+    # doesn't support remote AOT) + cost-analysis FLOPs, shared with
+    # bench.py so both tools degrade identically.
+    compiled, total_flops = _compile_with_flops(step, state, b)
     out["flops_per_step"] = total_flops
 
-    # Warmup, then timed loop (optionally traced).
-    state, m = compiled(state, b)
-    jax.block_until_ready(m)
-    state, m = compiled(state, b)
-    jax.block_until_ready(m)
-
-    def timed():
-        nonlocal state, m
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            state, m = compiled(state, b)
-        jax.block_until_ready(m)
-        return time.perf_counter() - t0
+    # Per-step time via the shared fetch-synchronized two-point method
+    # (bench.py:two_point_per_step — block_until_ready does not wait for
+    # remote execution through the axon relay).
+    per_step, state, _, degraded = two_point_per_step(
+        compiled, state, b, args.steps
+    )
+    out["timing"] = "single_run_with_rtt" if degraded else "two_point"
 
     if args.trace:
+        # Trace a separate short steady-state run so per-op attribution
+        # in xprof covers ONLY timed-representative steps (no warmup or
+        # calibration inside the traced region), ending with the one
+        # synchronizing fetch.
         with jax.profiler.trace(args.trace):
-            dt = timed()
+            for _ in range(5):
+                state, m = compiled(state, b)
+            float(m["loss"])
         out["trace_dir"] = args.trace
-    else:
-        dt = timed()
 
-    out["step_time_ms"] = round(dt / args.steps * 1e3, 3)
-    out["imgs_per_sec"] = round(3 * args.batch * args.steps / dt, 2)
-    out["achieved_flops_per_sec"] = total_flops / (dt / args.steps)
+    out["step_time_ms"] = round(per_step * 1e3, 3)
+    out["imgs_per_sec"] = round(3 * args.batch / per_step, 2)
+    if total_flops:
+        out["achieved_flops_per_sec"] = total_flops / per_step
 
     if args.ablate:
         # Same remat setting as the main step — otherwise the recompute
@@ -150,21 +146,20 @@ def main():
             args.model, args.batch, args.image, args.group_size,
             whiten=False, remat=args.remat,
         )
-        acompiled, aflops, _ = flops_of(astep, astate, ab)
-        astate, am = acompiled(astate, ab)
-        jax.block_until_ready(am)
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            astate, am = acompiled(astate, ab)
-        jax.block_until_ready(am)
-        adt = time.perf_counter() - t0
+        acompiled, aflops = _compile_with_flops(astep, astate, ab)
+        aper, astate, _, adegraded = two_point_per_step(
+            acompiled, astate, ab, args.steps
+        )
+        out["ablated_timing"] = (
+            "single_run_with_rtt" if adegraded else "two_point"
+        )
         out["ablated_flops_per_step"] = aflops
-        out["ablated_step_time_ms"] = round(adt / args.steps * 1e3, 3)
+        out["ablated_step_time_ms"] = round(aper * 1e3, 3)
         if total_flops and aflops:
             out["whitening_flops_share"] = round(
                 (total_flops - aflops) / total_flops, 4
             )
-        out["whitening_time_share"] = round((dt - adt) / dt, 4)
+        out["whitening_time_share"] = round((per_step - aper) / per_step, 4)
     print(json.dumps(out))
 
 
