@@ -25,16 +25,33 @@ SITES = {
 }
 
 
-def _time(fn, *args, steps=20):
+def _fetch(out):
+    """Force execution with a host fetch — through the axon relay,
+    block_until_ready resolves the local handle without waiting for
+    remote execution (see bench.py:two_point_per_step).  The chip runs
+    one stream, so fetching the LAST call's result waits for all queued
+    calls."""
     import jax
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.reshape(-1)[0])
+
+
+def _time(fn, *args, steps=20):
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        _fetch(out)
+        return time.perf_counter() - t0
+
+    run(2)  # warmup
+    # Two-point: cancels the fixed per-fetch relay round-trip.
+    n1 = max(1, steps // 4)
+    n2 = max(steps, n1 + 4)
+    dt1, dt2 = run(n1), run(n2)
+    per = (dt2 - dt1) / (n2 - n1)
+    return per if per > 0 else dt2 / n2
 
 
 def main():
