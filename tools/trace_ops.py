@@ -1,0 +1,157 @@
+"""Attribute step time per fused XLA op from a ``jax.profiler`` trace.
+
+Reads the ``*.xplane.pb`` under a trace directory (written by
+``tools/profile_step.py --trace DIR``) and prints a JSON report: total
+device time, per-HLO-category rollup, and the top-N fused ops by summed
+duration.  This is the measurement SURVEY §7 step 1 asks for before
+hand-writing Pallas kernels ("measure first") — it answers *where* the
+94.8 ms flagship step goes, without TensorBoard.
+
+Parsing uses the XPlane protobuf bundled with the baked-in tensorflow
+(``tensorflow.core.profiler.protobuf.xplane_pb2``); no network, no UI.
+
+Usage: python tools/trace_ops.py /tmp/dwt_trace [--top 40] [--line "XLA Ops"]
+"""
+
+import argparse
+import glob
+import json
+import os
+from collections import defaultdict
+
+
+def load_xspaces(trace_dir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        )
+    )
+    if not paths:
+        raise SystemExit(f"no *.xplane.pb under {trace_dir}")
+    spaces = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        spaces.append((p, xs))
+    return spaces
+
+
+def device_planes(xspace):
+    """TPU/accelerator planes if present, else the host plane (CPU runs)."""
+    dev = [
+        p
+        for p in xspace.planes
+        if p.name.startswith("/device:")
+        and "CPU" not in p.name
+        or "TPU" in p.name
+    ]
+    return dev or list(xspace.planes)
+
+
+def aggregate(plane, line_filter=None):
+    """Sum event durations per metadata name within matching lines."""
+    meta = plane.event_metadata
+    stat_meta = plane.stat_metadata
+    per_op = defaultdict(int)
+    per_category = defaultdict(int)
+    op_category = {}
+    for line in plane.lines:
+        if line_filter and line_filter.lower() not in line.name.lower():
+            continue
+        for ev in line.events:
+            md = meta.get(ev.metadata_id)
+            name = md.name if md else f"id{ev.metadata_id}"
+            per_op[name] += ev.duration_ps
+            cat = None
+            for st in ev.stats:
+                sm = stat_meta.get(st.metadata_id)
+                if sm and sm.name == "hlo_category":
+                    cat = (
+                        st.str_value
+                        or stat_meta.get(st.ref_value).name
+                        if st.ref_value
+                        else st.str_value
+                    )
+            if cat is None and md is not None:
+                for st in md.stats:
+                    sm = stat_meta.get(st.metadata_id)
+                    if sm and sm.name == "hlo_category":
+                        cat = st.str_value or (
+                            stat_meta.get(st.ref_value).name
+                            if st.ref_value
+                            else None
+                        )
+            op_category[name] = cat or "uncategorized"
+    for name, ps in per_op.items():
+        per_category[op_category[name]] += ps
+    return per_op, per_category, op_category
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument(
+        "--line",
+        default=None,
+        help="only lines whose name contains this (e.g. 'XLA Ops')",
+    )
+    ap.add_argument(
+        "--list-lines", action="store_true", help="just list plane/line names"
+    )
+    args = ap.parse_args()
+
+    spaces = load_xspaces(args.trace_dir)
+    report = {"trace_dir": args.trace_dir, "planes": []}
+    for path, xs in spaces:
+        for plane in device_planes(xs):
+            if args.list_lines:
+                print(
+                    json.dumps(
+                        {
+                            "file": os.path.basename(path),
+                            "plane": plane.name,
+                            "lines": [
+                                {"name": ln.name, "events": len(ln.events)}
+                                for ln in plane.lines
+                            ],
+                        }
+                    )
+                )
+                continue
+            per_op, per_cat, op_cat = aggregate(plane, args.line)
+            total_ps = sum(per_op.values())
+            if not total_ps:
+                continue
+            top = sorted(per_op.items(), key=lambda kv: -kv[1])[: args.top]
+            report["planes"].append(
+                {
+                    "file": os.path.basename(path),
+                    "plane": plane.name,
+                    "total_ms": round(total_ps / 1e9, 3),
+                    "categories_ms": {
+                        k: round(v / 1e9, 3)
+                        for k, v in sorted(
+                            per_cat.items(), key=lambda kv: -kv[1]
+                        )
+                    },
+                    "top_ops": [
+                        {
+                            "name": n,
+                            "ms": round(ps / 1e9, 3),
+                            "pct": round(100 * ps / total_ps, 2),
+                            "category": op_cat[n],
+                        }
+                        for n, ps in top
+                    ],
+                }
+            )
+    if not args.list_lines:
+        print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
