@@ -118,14 +118,84 @@ def group_cov(
     return cov / m
 
 
+# Unroll the factorization below this group size: LAPACK-style
+# ``jnp.linalg.cholesky``/``solve_triangular`` lower to sequential
+# column loops (While thunks on TPU) whose per-iteration latency dwarfs
+# the [G, g, g] arithmetic; a statically-unrolled Cholesky-Banachiewicz
+# + forward substitution is ~g^2 fused vector ops with no control flow.
+_UNROLL_MAX_G = 8
+
+
+def _cholesky_unrolled(a: jax.Array) -> jax.Array:
+    """Cholesky factor of batched tiny SPD matrices ``[..., g, g]``,
+    statically unrolled (g is a compile-time constant <= _UNROLL_MAX_G).
+
+    Same math as ``jnp.linalg.cholesky`` (parity pinned in
+    tests/test_whitening.py); every operation is elementwise over the
+    batch, so XLA fuses the whole factorization into one kernel.
+    """
+    g = a.shape[-1]
+    # cols[j][i] is scalar-per-batch L[..., i, j]; build column by column.
+    cols = [[None] * g for _ in range(g)]
+    for j in range(g):
+        d = a[..., j, j]
+        for k in range(j):
+            d = d - cols[k][j] * cols[k][j]
+        ljj = jnp.sqrt(d)
+        cols[j][j] = ljj
+        inv = 1.0 / ljj
+        for i in range(j + 1, g):
+            s = a[..., i, j]
+            for k in range(j):
+                s = s - cols[k][i] * cols[k][j]
+            cols[j][i] = s * inv
+    zero = jnp.zeros_like(a[..., 0, 0])
+    rows = [
+        jnp.stack(
+            [cols[j][i] if j <= i else zero for j in range(g)], axis=-1
+        )
+        for i in range(g)
+    ]
+    return jnp.stack(rows, axis=-2)
+
+
+def _tri_inverse_unrolled(L: jax.Array) -> jax.Array:
+    """``L^{-1}`` of batched tiny lower-triangular ``[..., g, g]`` by
+    statically-unrolled forward substitution (solve ``L X = I``)."""
+    g = L.shape[-1]
+    one = jnp.ones_like(L[..., 0, 0])
+    zero = jnp.zeros_like(one)
+    rows = []  # rows[i][j] = X[..., i, j]
+    for i in range(g):
+        inv = 1.0 / L[..., i, i]
+        row = []
+        for j in range(g):
+            if j > i:  # strict upper triangle of a lower-tri inverse
+                row.append(zero)
+                continue
+            s = one if i == j else zero
+            for k in range(j, i):  # X[k][j] == 0 for k < j (lower tri)
+                s = s - L[..., i, k] * rows[k][j]
+            row.append(s * inv)
+        rows.append(row)
+    return jnp.stack(
+        [jnp.stack(r, axis=-1) for r in rows], axis=-2
+    )
+
+
 def whitening_matrix(cov_shrunk: jax.Array) -> jax.Array:
     """``L^{-1}`` for ``cov = L L^T`` — the (triangular) whitening matrix.
 
     Cholesky whitening, not ZCA: applying ``L^{-1}`` to centered data gives
     identity covariance. Triangular solve against I replaces the reference's
     explicit ``inverse`` (``whitening.py:53``) for speed and VJP stability.
+    For the typical tiny group sizes (g<=8; the reference uses 4) both the
+    factorization and the solve are statically unrolled — no sequential
+    While-loop lowering on TPU.
     """
     g = cov_shrunk.shape[-1]
+    if g <= _UNROLL_MAX_G:
+        return _tri_inverse_unrolled(_cholesky_unrolled(cov_shrunk))
     chol = jnp.linalg.cholesky(cov_shrunk)
     eye = jnp.broadcast_to(jnp.eye(g, dtype=cov_shrunk.dtype), cov_shrunk.shape)
     return solve_triangular(chol, eye, lower=True)

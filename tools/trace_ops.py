@@ -1,14 +1,17 @@
 """Attribute step time per fused XLA op from a ``jax.profiler`` trace.
 
 Reads the ``*.xplane.pb`` under a trace directory (written by
-``tools/profile_step.py --trace DIR``) and prints a JSON report: total
-device time, per-HLO-category rollup, and the top-N fused ops by summed
-duration.  This is the measurement SURVEY §7 step 1 asks for before
-hand-writing Pallas kernels ("measure first") — it answers *where* the
-94.8 ms flagship step goes, without TensorBoard.
+``tools/profile_step.py --trace DIR``) and prints a JSON report with one
+entry PER PLANE LINE (lines overlap — e.g. "XLA Modules" spans the ops in
+"XLA Ops" — so they are never summed together): per-line total, an
+HLO-category rollup, and the top-N ops by summed duration.  This is the
+measurement SURVEY §7 step 1 asks for before hand-writing Pallas kernels
+("measure first") — it answers *where* the flagship step's time goes,
+without TensorBoard.
 
-Parsing uses the XPlane protobuf bundled with the baked-in tensorflow
-(``tensorflow.core.profiler.protobuf.xplane_pb2``); no network, no UI.
+On a TPU trace, the line to read is "XLA Ops" on the ``/device:TPU:0``
+plane.  Parsing uses the XPlane protobuf bundled with the baked-in
+tensorflow; no network, no UI.
 
 Usage: python tools/trace_ops.py /tmp/dwt_trace [--top 40] [--line "XLA Ops"]
 """
@@ -39,52 +42,53 @@ def load_xspaces(trace_dir):
     return spaces
 
 
-def device_planes(xspace):
-    """TPU/accelerator planes if present, else the host plane (CPU runs)."""
+def pick_planes(xspace):
+    """Accelerator planes (``/device:`` minus host-CPU) when present,
+    otherwise every plane (CPU-only runs)."""
     dev = [
         p
         for p in xspace.planes
-        if p.name.startswith("/device:")
-        and "CPU" not in p.name
-        or "TPU" in p.name
+        if p.name.startswith("/device:") and "CPU" not in p.name
     ]
     return dev or list(xspace.planes)
 
 
-def aggregate(plane, line_filter=None):
-    """Sum event durations per metadata name within matching lines."""
+def _stat_str(st, stat_meta):
+    """A stat's string value, resolving ref_value safely (None if absent)."""
+    if st.str_value:
+        return st.str_value
+    if st.ref_value:
+        sm = stat_meta.get(st.ref_value)
+        return sm.name if sm is not None else None
+    return None
+
+
+def _category(ev, md, stat_meta):
+    for holder in (ev, md):
+        if holder is None:
+            continue
+        for st in holder.stats:
+            sm = stat_meta.get(st.metadata_id)
+            if sm is not None and sm.name == "hlo_category":
+                val = _stat_str(st, stat_meta)
+                if val:
+                    return val
+    return "uncategorized"
+
+
+def aggregate_line(plane, line):
+    """Sum event durations per metadata name within ONE line."""
     meta = plane.event_metadata
     stat_meta = plane.stat_metadata
     per_op = defaultdict(int)
-    per_category = defaultdict(int)
     op_category = {}
-    for line in plane.lines:
-        if line_filter and line_filter.lower() not in line.name.lower():
-            continue
-        for ev in line.events:
-            md = meta.get(ev.metadata_id)
-            name = md.name if md else f"id{ev.metadata_id}"
-            per_op[name] += ev.duration_ps
-            cat = None
-            for st in ev.stats:
-                sm = stat_meta.get(st.metadata_id)
-                if sm and sm.name == "hlo_category":
-                    cat = (
-                        st.str_value
-                        or stat_meta.get(st.ref_value).name
-                        if st.ref_value
-                        else st.str_value
-                    )
-            if cat is None and md is not None:
-                for st in md.stats:
-                    sm = stat_meta.get(st.metadata_id)
-                    if sm and sm.name == "hlo_category":
-                        cat = st.str_value or (
-                            stat_meta.get(st.ref_value).name
-                            if st.ref_value
-                            else None
-                        )
-            op_category[name] = cat or "uncategorized"
+    for ev in line.events:
+        md = meta.get(ev.metadata_id)
+        name = md.name if md is not None else f"id{ev.metadata_id}"
+        per_op[name] += ev.duration_ps
+        if name not in op_category:
+            op_category[name] = _category(ev, md, stat_meta)
+    per_category = defaultdict(int)
     for name, ps in per_op.items():
         per_category[op_category[name]] += ps
     return per_op, per_category, op_category
@@ -105,9 +109,9 @@ def main():
     args = ap.parse_args()
 
     spaces = load_xspaces(args.trace_dir)
-    report = {"trace_dir": args.trace_dir, "planes": []}
+    report = {"trace_dir": args.trace_dir, "lines": []}
     for path, xs in spaces:
-        for plane in device_planes(xs):
+        for plane in pick_planes(xs):
             if args.list_lines:
                 print(
                     json.dumps(
@@ -122,33 +126,42 @@ def main():
                     )
                 )
                 continue
-            per_op, per_cat, op_cat = aggregate(plane, args.line)
-            total_ps = sum(per_op.values())
-            if not total_ps:
-                continue
-            top = sorted(per_op.items(), key=lambda kv: -kv[1])[: args.top]
-            report["planes"].append(
-                {
-                    "file": os.path.basename(path),
-                    "plane": plane.name,
-                    "total_ms": round(total_ps / 1e9, 3),
-                    "categories_ms": {
-                        k: round(v / 1e9, 3)
-                        for k, v in sorted(
-                            per_cat.items(), key=lambda kv: -kv[1]
-                        )
-                    },
-                    "top_ops": [
-                        {
-                            "name": n,
-                            "ms": round(ps / 1e9, 3),
-                            "pct": round(100 * ps / total_ps, 2),
-                            "category": op_cat[n],
-                        }
-                        for n, ps in top
-                    ],
-                }
-            )
+            for line in plane.lines:
+                if (
+                    args.line
+                    and args.line.lower() not in line.name.lower()
+                ):
+                    continue
+                per_op, per_cat, op_cat = aggregate_line(plane, line)
+                total_ps = sum(per_op.values())
+                if not total_ps:
+                    continue
+                top = sorted(per_op.items(), key=lambda kv: -kv[1])[
+                    : args.top
+                ]
+                report["lines"].append(
+                    {
+                        "file": os.path.basename(path),
+                        "plane": plane.name,
+                        "line": line.name,
+                        "total_ms": round(total_ps / 1e9, 3),
+                        "categories_ms": {
+                            k: round(v / 1e9, 3)
+                            for k, v in sorted(
+                                per_cat.items(), key=lambda kv: -kv[1]
+                            )
+                        },
+                        "top_ops": [
+                            {
+                                "name": n,
+                                "ms": round(ps / 1e9, 3),
+                                "pct": round(100 * ps / total_ps, 2),
+                                "category": op_cat[n],
+                            }
+                            for n, ps in top
+                        ],
+                    }
+                )
     if not args.list_lines:
         print(json.dumps(report, indent=1))
 
