@@ -1,0 +1,108 @@
+"""tools/trace_ops.py aggregation on a hand-built XSpace proto.
+
+Pins the properties the TPU go/no-go read depends on: per-line totals are
+never summed across overlapping lines, durations aggregate per op name,
+hlo_category resolves through stat refs without crashing on dangling
+refs, and host-CPU planes stay out of device reports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+tf_pb = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+    reason="tensorflow (xplane proto) not installed",
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import trace_ops  # noqa: E402
+
+
+def _build_space():
+    xs = tf_pb.XSpace()
+    dev = xs.planes.add()
+    dev.name = "/device:TPU:0"
+    # op metadata
+    dev.event_metadata[1].name = "fusion.1"
+    dev.event_metadata[2].name = "convolution.2"
+    dev.event_metadata[3].name = "whole-module"
+    dev.stat_metadata[10].name = "hlo_category"
+    dev.stat_metadata[11].name = "convolution"  # ref target for category
+    # category stat on metadata: fusion.1 via str_value, conv.2 via ref
+    st = dev.event_metadata[1].stats.add()
+    st.metadata_id = 10
+    st.str_value = "fusion"
+    st2 = dev.event_metadata[2].stats.add()
+    st2.metadata_id = 10
+    st2.ref_value = 11
+    # dangling ref: must not crash, falls back to uncategorized
+    dev.event_metadata[3].stats.add().metadata_id = 10
+
+    ops_line = dev.lines.add()
+    ops_line.name = "XLA Ops"
+    for md_id, dur in ((1, 7_000_000), (2, 3_000_000), (1, 5_000_000)):
+        ev = ops_line.events.add()
+        ev.metadata_id = md_id
+        ev.duration_ps = dur
+    mod_line = dev.lines.add()
+    mod_line.name = "XLA Modules"
+    ev = mod_line.events.add()
+    ev.metadata_id = 3
+    ev.duration_ps = 15_000_000
+
+    host = xs.planes.add()
+    host.name = "/host:CPU"
+    hl = host.lines.add()
+    hl.name = "python"
+    hev = hl.events.add()
+    hev.metadata_id = 1
+    hev.duration_ps = 999_000_000
+    return xs
+
+
+def test_aggregate_per_line_no_cross_line_double_count(tmp_path):
+    xs = _build_space()
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    (run_dir / "vm.xplane.pb").write_bytes(xs.SerializeToString())
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_ops.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout)
+    by_line = {l["line"]: l for l in report["lines"]}
+    # Host plane excluded when a device plane exists.
+    assert set(by_line) == {"XLA Ops", "XLA Modules"}
+    ops = by_line["XLA Ops"]
+    # 7+5 ps aggregated for fusion.1; 3 for convolution.2 — and the module
+    # line's 15 never leaks into the ops line's total.
+    assert ops["total_ms"] == pytest.approx(0.015)
+    top = {o["name"]: o for o in ops["top_ops"]}
+    assert top["fusion.1"]["ms"] == pytest.approx(0.012)
+    assert top["fusion.1"]["category"] == "fusion"
+    assert top["convolution.2"]["category"] == "convolution"
+    assert by_line["XLA Modules"]["top_ops"][0]["category"] == "uncategorized"
+
+
+def test_line_filter(tmp_path):
+    xs = _build_space()
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    (run_dir / "vm.xplane.pb").write_bytes(xs.SerializeToString())
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_ops.py"),
+         str(tmp_path), "--line", "xla ops"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout)
+    assert [l["line"] for l in report["lines"]] == ["XLA Ops"]
