@@ -201,8 +201,18 @@ def whitening_matrix(cov_shrunk: jax.Array) -> jax.Array:
     return solve_triangular(chol, eye, lower=True)
 
 
+def _block_diag_expand(w: jax.Array) -> jax.Array:
+    """``[G, g, g]`` per-group matrices -> one ``[C, C]`` block-diagonal
+    matrix (C = G*g) with ``B[(g,c),(h,d)] = w[h,d,c] * (g == h)``, so that
+    ``xn.reshape(-1, C) @ B`` equals the grouped apply."""
+    G, g = w.shape[0], w.shape[1]
+    eye = jnp.eye(G, dtype=w.dtype)
+    # rows indexed by (g_in, c), cols by (h_out, d).
+    return jnp.einsum("hdc,gh->gchd", w, eye).reshape(G * g, G * g)
+
+
 def apply_whitening(
-    xn: jax.Array, w: jax.Array, compute_dtype=None
+    xn: jax.Array, w: jax.Array, compute_dtype=None, lowering: str = "auto"
 ) -> jax.Array:
     """Apply per-group whitening matrix ``w [G, g, g]`` to centered ``xn``.
 
@@ -218,6 +228,20 @@ def apply_whitening(
     acc_dtype = jnp.promote_types(compute_dtype, jnp.float32)
     shape = xn.shape
     num_groups, group_size = w.shape[0], w.shape[1]
+    C = num_groups * group_size
+    if lowering not in ("auto", "grouped", "blockdiag"):
+        raise ValueError(f"unknown apply lowering: {lowering!r}")
+    if lowering == "auto":
+        # The grouped einsum contracts over only g (4) channels — a
+        # shape the MXU pads heavily.  For narrow C, expanding to one
+        # [C, C] block-diagonal matmul costs C/g more FLOPs but runs at
+        # full MXU tile efficiency; past C=128 the FLOP inflation wins.
+        lowering = "blockdiag" if C <= 128 else "grouped"
+    if lowering == "blockdiag":
+        t = xn.reshape(-1, C).astype(compute_dtype)
+        B = _block_diag_expand(w).astype(compute_dtype)
+        y = jnp.matmul(t, B, preferred_element_type=acc_dtype)
+        return y.reshape(shape).astype(xn.dtype)
     t = xn.reshape(-1, num_groups, group_size)
     y = jnp.einsum(
         "mgc,gdc->mgd",

@@ -19,9 +19,6 @@ tf_pb = pytest.importorskip(
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO, "tools"))
-
-import trace_ops  # noqa: E402
 
 
 def _build_space():
@@ -42,7 +39,9 @@ def _build_space():
     st2.metadata_id = 10
     st2.ref_value = 11
     # dangling ref: must not crash, falls back to uncategorized
-    dev.event_metadata[3].stats.add().metadata_id = 10
+    st3 = dev.event_metadata[3].stats.add()
+    st3.metadata_id = 10
+    st3.ref_value = 99  # no such stat_metadata entry
 
     ops_line = dev.lines.add()
     ops_line.name = "XLA Ops"

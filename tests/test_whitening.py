@@ -340,3 +340,36 @@ class TestUnrolledFactorization:
         for gi in range(2):
             blk = cov[4 * gi : 4 * gi + 4, 4 * gi : 4 * gi + 4]
             np.testing.assert_allclose(blk, np.eye(4), atol=5e-3)
+
+
+class TestApplyLowering:
+    """grouped vs block-diagonal apply lowerings are interchangeable
+    (auto picks blockdiag for C<=128 — MXU tile efficiency; see
+    apply_whitening)."""
+
+    @pytest.mark.parametrize("C,g", [(8, 4), (64, 4), (256, 4)])
+    def test_lowerings_match(self, C, g):
+        from dwt_tpu.ops.whitening import apply_whitening
+
+        rng = np.random.default_rng(0)
+        xn = jnp.asarray(rng.normal(size=(97, C)), jnp.float32)
+        G = C // g
+        w = jnp.asarray(rng.normal(size=(G, g, g)), jnp.float32)
+        y_g = apply_whitening(xn, w, lowering="grouped")
+        y_b = apply_whitening(xn, w, lowering="blockdiag")
+        np.testing.assert_allclose(y_g, y_b, rtol=1e-6, atol=1e-6)
+
+    def test_lowerings_match_bf16(self):
+        from dwt_tpu.ops.whitening import apply_whitening
+
+        rng = np.random.default_rng(1)
+        xn = jnp.asarray(rng.normal(size=(64, 16)), jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(4, 4, 4)), jnp.float32)
+        y_g = apply_whitening(xn, w, compute_dtype=jnp.bfloat16,
+                              lowering="grouped")
+        y_b = apply_whitening(xn, w, compute_dtype=jnp.bfloat16,
+                              lowering="blockdiag")
+        np.testing.assert_allclose(
+            np.asarray(y_g, np.float32), np.asarray(y_b, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )

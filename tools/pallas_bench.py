@@ -102,6 +102,25 @@ def main():
             "backend": backend,
             "pallas_interpret_mode": interpret,
         }
+        # Apply-lowering A/B (grouped tiny-K einsum vs block-diag matmul;
+        # apply_whitening's "auto" picks blockdiag for C<=128) — isolates
+        # the one sub-op with an MXU-shape choice.
+        from dwt_tpu.ops.whitening import apply_whitening
+
+        w_rand = jnp.asarray(
+            np.random.default_rng(1).normal(size=(c // 4, 4, 4)),
+            jnp.float32,
+        )
+        for lowering in ("grouped", "blockdiag"):
+            fn = jax.jit(
+                lambda x, lo=lowering: apply_whitening(
+                    x, w_rand, compute_dtype=dtype, lowering=lo
+                )
+            )
+            record[f"apply_{lowering}_ms"] = round(
+                _time(fn, x, steps=args.steps) * 1e3, 3
+            )
+
         record["xla_fwd_ms"] = round(
             _time(jax.jit(xla_fwd), x, steps=args.steps) * 1e3, 3
         )
