@@ -232,11 +232,19 @@ def apply_whitening(
     if lowering not in ("auto", "grouped", "blockdiag"):
         raise ValueError(f"unknown apply lowering: {lowering!r}")
     if lowering == "auto":
-        # The grouped einsum contracts over only g (4) channels — a
-        # shape the MXU pads heavily.  For narrow C, expanding to one
-        # [C, C] block-diagonal matmul costs C/g more FLOPs but runs at
-        # full MXU tile efficiency; past C=128 the FLOP inflation wins.
-        lowering = "blockdiag" if C <= 128 else "grouped"
+        # The grouped einsum contracts over only g (4) channels — a shape
+        # both the MXU (heavy tile padding) and CPU BLAS (strided tiny
+        # batched matmuls) handle poorly.  The [C, C] block-diagonal
+        # matmul costs C/g more FLOPs but runs dense: measured on CPU it
+        # is 7x (C=64) to 17x (C=256) faster than grouped despite the
+        # inflation, so CPU always takes it; on TPU it is taken for
+        # narrow C where the padding waste dominates, and past C=128 the
+        # C/g FLOP inflation plausibly wins — tools/pallas_bench.py's
+        # apply_{grouped,blockdiag}_ms A/B is the data to revisit this.
+        if jax.default_backend() == "cpu":
+            lowering = "blockdiag"
+        else:
+            lowering = "blockdiag" if C <= 128 else "grouped"
     if lowering == "blockdiag":
         t = xn.reshape(-1, C).astype(compute_dtype)
         B = _block_diag_expand(w).astype(compute_dtype)

@@ -25,7 +25,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _compile_with_flops, two_point_per_step  # noqa: E402
+from bench import (  # noqa: E402
+    _compile_with_flops,
+    enable_compile_cache,
+    two_point_per_step,
+)
 
 
 def build_step(model_name: str, batch: int, image: int, group_size: int,
@@ -70,6 +74,7 @@ def build_step(model_name: str, batch: int, image: int, group_size: int,
 
 
 def main():
+    enable_compile_cache()
     import jax
 
     ap = argparse.ArgumentParser()
