@@ -33,20 +33,25 @@ import time
 
 # First real-TPU measurement anchors vs_baseline; None -> vs_baseline=1.0.
 # The anchor is ONLY comparable to runs of the same metric (flagship
-# resnet50 at 224px) — other model/resolution records report vs_baseline=1.
+# resnet50 at 224px) — other model/resolution records report vs_baseline=1 —
+# AND of the same timing method: a scan-amortized step time divided into a
+# per-call anchor would report a phantom speedup, so when the run's timing
+# mode differs from BASELINE_TIMING the ratio uses the run's matching
+# per-call number instead.
 # Anchor: round-4 first honest TPU v5e number (2026-07-29), 94.8 ms/step,
-# MFU 0.070, fetch-synchronized two-point timing.
+# MFU 0.070, fetch-synchronized per-call two-point timing.
 BASELINE_IMGS_PER_SEC = 569.64
 BASELINE_METRIC = "resnet50_dwt_train_imgs_per_sec"
+BASELINE_TIMING = "two_point"
 
 _RELAY_VAR = "PALLAS_AXON_POOL_IPS"
 # Backend init + one tiny compile (first compile 20-40s); overridable so a
 # wedged-relay environment fails fast when the operator knows it's down.
-# Worst-case budget: tunnel down = BENCH_RELAY_WAIT_S TCP poll (300 s) +
-# CPU-fallback resnet50@96px child (~45 s compile + ~6.5 s/step x 5 steps,
-# ~100 s total); tunnel up but wedged = 2 hung probes (2x150 s) + retry
-# sleep + the same fallback child — either path fits a 10-minute driver
-# timeout only via the defaults below, so size them together.
+# Worst-case budget (probe-first flow, since the TCP port check is only
+# advisory): hung probe (150 s) + BENCH_RELAY_WAIT_S TCP poll (120 s) +
+# hung re-probe (150 s) + CPU-fallback resnet50@96px child (~45 s compile
+# + ~6.5 s/step x 5 steps, ~100 s total) ≈ 520 s — fits a 10-minute
+# driver timeout only via the defaults below, so size them together.
 _PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
 
 # Peak dense bf16 FLOP/s per chip by device-kind substring (public specs).
@@ -231,15 +236,90 @@ def two_point_per_step(step, state, batch, steps, warmup=3):
     return per_step, state, loss, degraded
 
 
+def scan_steps_fn(step_fn, k: int):
+    """Wrap a train step in a ``lax.scan`` of ``k`` device steps per
+    dispatch.  Through the axon relay every dispatch costs a host round
+    trip that two-point timing cannot cancel (it cancels the *fetch*, not
+    the per-call dispatch); k steps per call amortize it k-fold, so the
+    marginal time/k converges to the chip's true step time — the number a
+    non-relay deployment would see.  ``step_fn`` must be the raw (un-AOT)
+    step; the scan body is compiled once inside the outer jit."""
+    import jax
+    from jax import lax
+
+    def run_k(state, batch):
+        def body(s, _):
+            s, m = step_fn(s, batch)
+            return s, m["loss"]
+
+        state, losses = lax.scan(body, state, None, length=k)
+        return state, {"loss": losses[-1]}
+
+    return jax.jit(run_k, donate_argnums=0)
+
+
+_SCAN_K = int(os.environ.get("BENCH_SCAN_K", "8"))
+
+
+def scan_two_point(raw_step, state, batch, steps, k):
+    """Two-point timing of ``k`` scanned device steps per dispatch.
+
+    Shared by bench.py and tools/profile_step.py (same call-count
+    calibration, same per-step division) so the two tools' scan numbers
+    stay comparable.  Returns ``(per_step, state, loss, degraded)``.
+    """
+    run_k = scan_steps_fn(raw_step, k)
+    calls = max(3, steps // k + 2)
+    per_call, state, loss, degraded = two_point_per_step(
+        run_k, state, batch, calls
+    )
+    return per_call / k, state, loss, degraded
+
+
+def timing_label(scan_k: int, degraded: bool) -> str:
+    """Three-way timing label, shared by bench.py and profile_step.py so
+    identically-labeled numbers are measured identically."""
+    if scan_k and not degraded:
+        return f"scan_k{scan_k}_two_point"
+    return "single_run_with_rtt" if degraded else "two_point"
+
+
 def _time_steps(step, state, batch, steps, imgs_per_step):
+    import jax
     import numpy as np
 
+    raw_step = step
     step, flops_per_step = _compile_with_flops(step, state, batch)
     per_step, state, loss, degraded = two_point_per_step(
         step, state, batch, steps
     )
+    # Device-truth timing: k steps per dispatch via lax.scan.  Skipped on
+    # CPU, where dispatch is already free and the scanned program would
+    # only pay a second full compile; elsewhere, falls back to the
+    # per-call number if the scanned variant fails or runs slower.
+    info = {"step_time_ms_percall": round(per_step * 1e3, 3)}
+    if degraded:
+        # Per-call number is a single-run average that re-includes the
+        # fetch RTT — flagged so readers (and the vs_baseline methodology
+        # correction) don't mistake it for a clean two-point measurement,
+        # and never booked against the scan number as "dispatch overhead".
+        info["percall_degraded"] = True
+    if _SCAN_K > 0 and jax.default_backend() != "cpu":
+        try:
+            scan_per_step, state, loss, sdeg = scan_two_point(
+                raw_step, state, batch, steps, _SCAN_K
+            )
+            if not sdeg and 0 < scan_per_step < per_step:
+                info["timing_mode"] = timing_label(_SCAN_K, False)
+                if not degraded:
+                    info["dispatch_overhead_ms_per_step"] = round(
+                        (per_step - scan_per_step) * 1e3, 3
+                    )
+                per_step, degraded = scan_per_step, False
+        except Exception as e:
+            print(f"bench: scan timing unavailable ({e!r})", file=sys.stderr)
     assert np.isfinite(loss), "non-finite loss in bench"
-    return imgs_per_step / per_step, per_step, flops_per_step, degraded
+    return imgs_per_step / per_step, per_step, flops_per_step, degraded, info
 
 
 def _relay_endpoints():
@@ -297,8 +377,8 @@ def _relay_diagnosis(mode: str = "hung") -> str:
     if not open_ports:
         ports = "/".join(str(p) for p in probe_ports)
         return (
-            f"relay {host} ports {ports} refused — TPU tunnel is not "
-            "running"
+            f"relay {host} ports {ports} refused — TPU tunnel likely "
+            "down (advisory: the relay transport may not use these ports)"
         )
     return (
         f"relay {host} port(s) {open_ports} open but init {mode} — "
@@ -332,8 +412,8 @@ def _wait_for_relay(max_wait_s: int):
             host, probe_ports = _relay_endpoints()
             ports = "/".join(str(p) for p in probe_ports)
             return False, (
-                f"relay {host} ports {ports} refused — TPU tunnel is not "
-                "running"
+                f"relay {host} ports {ports} stayed closed for the full "
+                "poll window"
             )
         time.sleep(10)
 
@@ -440,46 +520,47 @@ def main():
         ap.error("--pallas only applies to --model resnet50")
 
     if not args.no_probe:
-        # Cheap TCP poll first: when the tunnel is down the gRPC client
-        # retries refused connections forever, so burning two 150-s jax
-        # probes is pointless — poll up to BENCH_RELAY_WAIT_S (default
-        # 5 min), then fall back with the port-level diagnosis.
-        relay_ok, poll_diagnosis = _wait_for_relay(
-            int(os.environ.get("BENCH_RELAY_WAIT_S", "300"))
-        )
-        if not relay_ok:
-            sys.exit(
-                _reexec_cpu_fallback(
-                    args,
-                    f"tpu relay unreachable after tcp poll ({poll_diagnosis})",
-                )
-            )
+        # The subprocess jax probe is AUTHORITATIVE; the TCP port poll is
+        # only advisory.  The relay's transport changed once already
+        # (8082/8083 stopped listening while the backend kept working),
+        # so closed probe ports must never skip the real probe — they
+        # only inform how long to wait before giving up after a probe
+        # failure.
         failure = _probe_backend()
         if failure is not None:
-            print("bench: retrying backend probe once...", file=sys.stderr)
-            time.sleep(10)
-            failure = _probe_backend()
-        if failure is not None:
-            sys.exit(
-                _reexec_cpu_fallback(
-                    args,
-                    "tpu backend init failed twice "
-                    f"({_relay_diagnosis(failure)})",
-                )
+            # Probe failed: if the advisory ports are closed the tunnel
+            # is plausibly down — poll cheaply (up to BENCH_RELAY_WAIT_S,
+            # default 2 min; see the worst-case budget at
+            # _PROBE_TIMEOUT_S) in case it comes back, then re-probe once
+            # either way.
+            relay_ok, poll_diagnosis = _wait_for_relay(
+                int(os.environ.get("BENCH_RELAY_WAIT_S", "120"))
             )
+            print("bench: retrying backend probe once...", file=sys.stderr)
+            failure = _probe_backend()
+            if failure is not None:
+                diagnosis = _relay_diagnosis(failure)
+                if not relay_ok and poll_diagnosis:
+                    diagnosis += f"; tcp poll: {poll_diagnosis}"
+                sys.exit(
+                    _reexec_cpu_fallback(
+                        args,
+                        f"tpu backend init failed twice ({diagnosis})",
+                    )
+                )
 
     enable_compile_cache()
     import jax
 
     if args.model == "lenet":
         batch = args.batch or 32
-        imgs_per_sec, step_time, flops, degraded = _bench_lenet(
+        imgs_per_sec, step_time, flops, degraded, tinfo = _bench_lenet(
             args.steps, batch
         )
         metric = "lenet_dwt_train_imgs_per_sec"
     else:
         batch = args.batch or 18
-        imgs_per_sec, step_time, flops, degraded = _bench_resnet50(
+        imgs_per_sec, step_time, flops, degraded, tinfo = _bench_resnet50(
             args.steps, batch, args.image, use_pallas=args.pallas
         )
         metric = (
@@ -505,14 +586,25 @@ def main():
     if peak is not None and flops:
         mfu = flops / step_time / peak
 
+    timing_label = tinfo.get(
+        "timing_mode", "single_run_with_rtt" if degraded else "two_point"
+    )
     # Only normalize runs comparable to the anchored workload — the
     # flagship 224px metric and its --pallas A/B twin (same model, same
     # shapes, different whitening lowering: the one ratio PERF.md's
     # go/no-go needs).  A 96px CPU fallback divided by a 224px TPU anchor
-    # would be a meaningless ratio.
+    # would be a meaningless ratio.  Methodology guard: when this run's
+    # timing mode differs from the anchor's (BASELINE_TIMING), the ratio
+    # uses the run's per-call number — a scan-amortized step time divided
+    # into a per-call anchor would book the dispatch overhead as speedup.
     anchored = metric in (BASELINE_METRIC, BASELINE_METRIC + "_pallas")
+    vs_value = imgs_per_sec
+    if timing_label != BASELINE_TIMING and "step_time_ms_percall" in tinfo:
+        vs_value = (
+            imgs_per_sec * step_time / (tinfo["step_time_ms_percall"] / 1e3)
+        )
     vs = (
-        imgs_per_sec / BASELINE_IMGS_PER_SEC
+        vs_value / BASELINE_IMGS_PER_SEC
         if BASELINE_IMGS_PER_SEC is not None and anchored
         else 1.0
     )
@@ -528,17 +620,27 @@ def main():
         "baseline_imgs_per_sec": (
             BASELINE_IMGS_PER_SEC if anchored else None
         ),
+        "baseline_timing": BASELINE_TIMING if anchored else None,
         "step_time_ms": round(step_time * 1e3, 3),
         "mfu": None if mfu is None else round(mfu, 4),
         "flops_per_step": flops,
         "flops_source": flops_source,
         "backend": jax.default_backend(),
         "device_kind": device_kind,
-        # two_point = fetch-synchronized relay-RTT-cancelling timing;
+        # scan_kN_two_point = N device steps per dispatch (amortizes the
+        # relay dispatch round-trip: the chip-truth number);
+        # two_point = fetch-synchronized per-call timing;
         # single_run_with_rtt = degenerate fallback that re-includes the
         # fetch round-trip (fast steps + timing jitter).
-        "timing": "single_run_with_rtt" if degraded else "two_point",
+        "timing": timing_label,
     }
+    for k in (
+        "step_time_ms_percall",
+        "percall_degraded",
+        "dispatch_overhead_ms_per_step",
+    ):
+        if k in tinfo:
+            record[k] = tinfo[k]
     if args.model == "resnet50":
         record["image_size"] = args.image
     if args.fallback_note:

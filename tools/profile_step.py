@@ -28,8 +28,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (  # noqa: E402
     _compile_with_flops,
     enable_compile_cache,
+    scan_two_point,
+    timing_label,
     two_point_per_step,
 )
+
+
+def _time_variant(raw_step, compiled, state, b, steps, scan_k):
+    """Time one step variant: ``scan_k`` > 0 amortizes the relay dispatch
+    round-trip over k device steps per call (bench.scan_two_point — the
+    shared calibration, so this tool's numbers match bench.py's); 0 uses
+    the per-call AOT-compiled path.
+
+    Returns ``(per_step, state, degraded, used_scan_k)`` — a degraded
+    scan measurement (non-positive two-point difference) is discarded in
+    favor of the per-call path, because a k-amortized single-run average
+    is comparable to neither the scan nor the per-call label.
+    """
+    if scan_k:
+        per_step, state, _, degraded = scan_two_point(
+            raw_step, state, b, steps, scan_k
+        )
+        if not degraded:
+            return per_step, state, False, scan_k
+    per_step, state, _, degraded = two_point_per_step(
+        compiled, state, b, steps
+    )
+    return per_step, state, degraded, 0
 
 
 def build_step(model_name: str, batch: int, image: int, group_size: int,
@@ -99,6 +124,11 @@ def main():
                     help="profile with the Pallas whitening kernels — "
                          "pair with a plain run for the full-step A/B "
                          "behind PERF.md's go/no-go")
+    ap.add_argument("--scan", type=int, default=0, metavar="K",
+                    help="time K device steps per dispatch (lax.scan): "
+                         "amortizes the relay dispatch round-trip that "
+                         "per-call timing cannot cancel — use on TPU for "
+                         "chip-truth numbers (suggest 8)")
     args = ap.parse_args()
 
     out = {
@@ -122,11 +152,12 @@ def main():
 
     # Per-step time via the shared fetch-synchronized two-point method
     # (bench.py:two_point_per_step — block_until_ready does not wait for
-    # remote execution through the axon relay).
-    per_step, state, _, degraded = two_point_per_step(
-        compiled, state, b, args.steps
+    # remote execution through the axon relay); --scan K amortizes the
+    # per-dispatch round-trip on top of that.
+    per_step, state, degraded, used_k = _time_variant(
+        step, compiled, state, b, args.steps, args.scan
     )
-    out["timing"] = "single_run_with_rtt" if degraded else "two_point"
+    out["timing"] = timing_label(used_k, degraded)
 
     if args.trace:
         # Trace a separate short steady-state run so per-op attribution
@@ -152,12 +183,10 @@ def main():
             whiten=False, remat=args.remat,
         )
         acompiled, aflops = _compile_with_flops(astep, astate, ab)
-        aper, astate, _, adegraded = two_point_per_step(
-            acompiled, astate, ab, args.steps
+        aper, astate, adegraded, aused_k = _time_variant(
+            astep, acompiled, astate, ab, args.steps, args.scan
         )
-        out["ablated_timing"] = (
-            "single_run_with_rtt" if adegraded else "two_point"
-        )
+        out["ablated_timing"] = timing_label(aused_k, adegraded)
         out["ablated_flops_per_step"] = aflops
         out["ablated_step_time_ms"] = round(aper * 1e3, 3)
         if total_flops and aflops:
