@@ -24,14 +24,18 @@ from dwt_tpu.data.datasets import (
 )
 from dwt_tpu.data.transforms import (
     Compose,
+    FusedAffineBlurNormalize,
+    FusedToArrayNormalize,
     Normalize,
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
     ThreadLocalRng,
     ToArray,
+    draw_affine_matrix,
     gaussian_blur,
     random_affine,
+    warp_affine,
 )
 from dwt_tpu.data.loader import (
     batch_iterator,
@@ -45,14 +49,18 @@ __all__ = [
     "load_mnist",
     "load_usps",
     "Compose",
+    "FusedAffineBlurNormalize",
+    "FusedToArrayNormalize",
     "Normalize",
     "RandomCrop",
     "RandomHorizontalFlip",
     "Resize",
     "ThreadLocalRng",
     "ToArray",
+    "draw_affine_matrix",
     "gaussian_blur",
     "random_affine",
+    "warp_affine",
     "batch_iterator",
     "infinite",
     "prefetch_to_device",

@@ -137,13 +137,22 @@ class RandomHorizontalFlip:
 
 class ToArray:
     """PIL (or numpy) → HWC float32 in [0, 1] — torch ``ToTensor`` minus
-    the NCHW permute (TPU wants channels-last)."""
+    the NCHW permute (TPU wants channels-last).
+
+    Integer-dtype input always divides by 255 (torch ``ToTensor``
+    semantics — value-sniffing would misread an all-dark uint8 crop as
+    already-normalized, diverging from the native fused path on exactly
+    those images); float input divides only when it looks 255-ranged.
+    """
 
     def __call__(self, img) -> np.ndarray:
-        a = np.asarray(img, dtype=np.float32)
+        raw = np.asarray(img)
+        a = raw.astype(np.float32)
         if a.ndim == 2:
             a = a[:, :, None]
-        if a.max() > 1.5:  # uint8-ranged input
+        if raw.dtype.kind in "ui":
+            a = a / 255.0
+        elif a.max() > 1.5:  # 255-ranged float input
             a = a / 255.0
         return a
 
@@ -157,19 +166,33 @@ class Normalize:
         return (a - self.mean) / self.std
 
 
-def random_affine(
-    a: np.ndarray, sigma: float = 0.1, rng: np.random.Generator | None = None
+def draw_affine_matrix(
+    rng: np.random.Generator, sigma: float = 0.1
 ) -> np.ndarray:
-    """The reference's ``_random_affine_augmentation`` on HWC arrays
-    (``resnet50…py:481-487``): identity 2x3 matrix with N(0, sigma)
-    perturbations, zero translation."""
-    rng = rng or np.random.default_rng()
-    m = np.float32(
+    """The reference's random 2x3 matrix (``resnet50…py:482-485``):
+    identity with N(0, sigma) perturbations, zero translation.  Split out
+    so the native fused path and the cv2/scipy path consume the SAME rng
+    draws in the same order (stream compatibility between the two)."""
+    return np.float32(
         [
             [1 + rng.normal(0, sigma), rng.normal(0, sigma), 0],
             [rng.normal(0, sigma), 1 + rng.normal(0, sigma), 0],
         ]
     )
+
+
+def random_affine(
+    a: np.ndarray, sigma: float = 0.1, rng: np.random.Generator | None = None
+) -> np.ndarray:
+    """The reference's ``_random_affine_augmentation`` on HWC arrays
+    (``resnet50…py:481-487``)."""
+    rng = rng or np.random.default_rng()
+    return warp_affine(a, draw_affine_matrix(rng, sigma))
+
+
+def warp_affine(a: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """``cv2.warpAffine(a, m, (w, h))`` default semantics (bilinear,
+    zero border, ``m`` inverted internally), with a scipy fallback."""
     h, w = a.shape[:2]
     if _HAS_CV2:
         out = cv2.warpAffine(a, m, (w, h))
@@ -192,6 +215,80 @@ def random_affine(
         axis=-1,
     )
     return out.astype(np.float32)
+
+
+class FusedToArrayNormalize:
+    """``ToArray() → Normalize(mean, std)`` in one native pass over the
+    uint8 pixels (``dwt_tpu.native.normalize_from_u8``), falling back to
+    the two-step numpy path when the native library is unavailable or the
+    input isn't plain uint8 HWC.  Bit-compatible up to float32 rounding:
+    both compute ``(v/255 - mean)/std``."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self._fallback = Compose([ToArray(), Normalize(mean, std)])
+
+    def __call__(self, img) -> np.ndarray:
+        from dwt_tpu import native
+
+        a = np.asarray(img)
+        if (
+            native.available()
+            and a.dtype == np.uint8
+            and a.ndim == 3
+            and a.shape[-1] <= 16
+        ):
+            return native.normalize_from_u8(a, self.mean, self.std)
+        return self._fallback(img)
+
+
+class FusedAffineBlurNormalize:
+    """The aug-view tail ``ToArray → random_affine → gaussian_blur →
+    Normalize`` as one native pass (``warp_affine_normalize_from_u8``).
+
+    Draws the affine matrix with :func:`draw_affine_matrix` — the same
+    rng calls in the same order as :func:`random_affine` — so the fused
+    and fallback paths consume identical random streams.  The fusion is
+    only taken when the blur is its reference-default no-op
+    (``ksize = int(sigma+0.5)*8+1 <= 1``, ``resnet50…py:489-492``);
+    otherwise the unfused chain runs.
+    """
+
+    def __init__(
+        self,
+        mean: Sequence[float],
+        std: Sequence[float],
+        affine_sigma: float = 0.1,
+        blur_sigma: float = 0.1,
+        rng=None,
+    ):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.affine_sigma = affine_sigma
+        self.blur_sigma = blur_sigma
+        self.rng = rng or np.random.default_rng()
+        self.normalize = Normalize(mean, std)
+        self.to_array = ToArray()
+
+    def __call__(self, img) -> np.ndarray:
+        from dwt_tpu import native
+
+        a = np.asarray(img)
+        m = draw_affine_matrix(self.rng, self.affine_sigma)
+        blur_is_noop = int(self.blur_sigma + 0.5) * 8 + 1 <= 1
+        if (
+            blur_is_noop
+            and native.available()
+            and a.dtype == np.uint8
+            and a.ndim == 3
+            and a.shape[-1] <= 16
+        ):
+            return native.warp_affine_normalize_from_u8(
+                a, m, self.mean, self.std
+            )
+        x = warp_affine(self.to_array(img), m)
+        return self.normalize(gaussian_blur(x, self.blur_sigma))
 
 
 def gaussian_blur(a: np.ndarray, sigma: float = 0.1) -> np.ndarray:

@@ -1,0 +1,193 @@
+"""Native (C++) host-side kernels for the input pipeline, ctypes-loaded.
+
+The compute path of the framework is JAX/XLA on TPU; the *host* runtime
+around it — here, the per-item augmentation tail of the data loader —
+is native C++ (``augment.cpp``), mirroring how the reference leans on
+torchvision/cv2 native loops (``resnet50_dwt_mec_officehome.py:481-492``)
+rather than Python pixel math.
+
+Design:
+
+* **Build on demand, never required.**  ``load()`` compiles
+  ``augment.cpp`` with g++ into a cache directory on first use (~1 s),
+  memoizes the handle, and returns ``None`` on any failure (no compiler,
+  read-only FS, exotic platform) — callers fall back to the numpy/cv2
+  path.  Set ``DWT_DISABLE_NATIVE=1`` to force the fallback (used for
+  pipeline A/B benchmarks).
+* **ctypes, not a CPython extension module** — no Python.h/pybind11
+  dependency, no per-interpreter ABI; and ctypes drops the GIL during
+  the call, so ``batch_iterator``'s worker threads scale on real
+  multi-core hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "augment.cpp")
+_LIB_NAME = "_dwtnative.so"
+
+_lib = None
+_load_attempted = False
+_load_error: str | None = None
+# Serializes build+load: batch_iterator's worker threads may race into
+# load() on a cold cache; without the lock two threads could compile to
+# the same path concurrently, and every thread arriving mid-build would
+# silently take the numpy fallback — making which items get which
+# numerics scheduler-dependent.  With it, first thread builds (~1 s),
+# the rest block and then share the handle.
+_load_lock = threading.Lock()
+
+
+def _lib_path() -> str:
+    """Where to build/load the .so.
+
+    Package dir when writable (dev checkout), with an atomic
+    rename-into-place so concurrent *processes* never load a
+    half-written file.  Otherwise a fresh private (0700, random-name)
+    per-process directory — deliberately NOT a predictable shared /tmp
+    path, which another local user could pre-seed with a hostile .so.
+    The per-process rebuild costs ~1 s once.
+    """
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    if os.access(pkg, os.W_OK):
+        return os.path.join(pkg, _LIB_NAME)
+    return os.path.join(tempfile.mkdtemp(prefix="dwt_native_"), _LIB_NAME)
+
+
+def _build(out_path: str) -> None:
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        raise RuntimeError("no C++ compiler on PATH")
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    cmd = [
+        gxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"g++ failed: {proc.stderr[-500:]}")
+        os.replace(tmp, out_path)  # atomic within the directory
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load():
+    """The ctypes library handle, building it if needed; None on failure."""
+    global _lib, _load_attempted, _load_error
+    if _lib is not None:
+        return _lib
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        if _load_attempted:
+            return None
+        _load_attempted = True
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib, _load_error
+    if os.environ.get("DWT_DISABLE_NATIVE") == "1":
+        _load_error = "disabled by DWT_DISABLE_NATIVE=1"
+        return None
+    try:
+        path = _lib_path()
+        if (
+            not os.path.exists(path)
+            or os.path.getmtime(path) < os.path.getmtime(_SRC)
+        ):
+            _build(path)
+        lib = ctypes.CDLL(path)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.dwt_norm_u8.argtypes = [
+            u8p, ctypes.c_longlong, ctypes.c_int, f32p, f32p, f32p
+        ]
+        lib.dwt_norm_u8.restype = None
+        lib.dwt_warp_affine_norm_u8.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, f32p, f32p, f32p,
+        ]
+        lib.dwt_warp_affine_norm_u8.restype = None
+        _lib = lib
+    except Exception as e:  # pragma: no cover - environment-dependent
+        _load_error = f"{type(e).__name__}: {e}"
+        print(
+            f"dwt_tpu.native: build/load failed ({_load_error}); "
+            "using the numpy/cv2 fallback path",
+            file=sys.stderr,
+        )
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _f32p(a):
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    # Returning the array too keeps the buffer alive across the call.
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), a
+
+
+def normalize_from_u8(
+    a: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """``(a/255 - mean)/std`` in one native pass; ``a`` uint8 HWC."""
+    lib = load()
+    assert lib is not None, "call available() first"
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    h, w, c = a.shape
+    if not 1 <= c <= 16:
+        # The C kernels statically bound their per-channel scale/bias
+        # arrays at 16 and silently no-op beyond it — never hand back
+        # uninitialized output instead of an error.
+        raise ValueError(f"native kernels support 1..16 channels, got {c}")
+    out = np.empty((h, w, c), np.float32)
+    (pm, _m), (ps, _s), (po, _o) = _f32p(mean), _f32p(std), _f32p(out)
+    lib.dwt_norm_u8(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_longlong(h * w),
+        ctypes.c_int(c),
+        pm, ps, po,
+    )
+    return out
+
+
+def warp_affine_normalize_from_u8(
+    a: np.ndarray, m: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """cv2.warpAffine(default flags) + /255 + normalize, one native pass.
+
+    ``a`` uint8 HWC; ``m`` the forward 2x3 float32 matrix exactly as
+    cv2.warpAffine would receive it.
+    """
+    lib = load()
+    assert lib is not None, "call available() first"
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    h, w, c = a.shape
+    if not 1 <= c <= 16:
+        raise ValueError(f"native kernels support 1..16 channels, got {c}")
+    out = np.empty((h, w, c), np.float32)
+    (pM, _M), (pm, _m), (ps, _s), (po, _o) = (
+        _f32p(m), _f32p(mean), _f32p(std), _f32p(out)
+    )
+    lib.dwt_warp_affine_norm_u8(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int(h), ctypes.c_int(w), ctypes.c_int(c),
+        pM, pm, ps, po,
+    )
+    return out

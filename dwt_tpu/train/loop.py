@@ -29,13 +29,13 @@ from dwt_tpu.config import DigitsConfig, OfficeHomeConfig
 from dwt_tpu.data import (
     ArrayDataset,
     Compose,
+    FusedAffineBlurNormalize,
+    FusedToArrayNormalize,
     ImageFolderDataset,
-    Normalize,
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
     ThreadLocalRng,
-    ToArray,
     batch_iterator,
     gaussian_blur,
     infinite,
@@ -500,12 +500,15 @@ def _officehome_datasets(cfg: OfficeHomeConfig):
     rng = ThreadLocalRng(cfg.seed)
     # Source/test transform (resnet50…py:527-532) and the target aug view
     # (:535-543): hflip → affine → blur before normalize.
+    # The pixel-math tails are fused native (C++) passes when available —
+    # ToArray+Normalize (both views) and ToArray+affine+blur+Normalize
+    # (aug view) each become one read of the uint8 image — with
+    # stream-identical numpy/cv2 fallbacks inside the Fused* transforms.
     base_tf = Compose(
         [
             Resize(cfg.img_resize),
             RandomCrop(cfg.img_crop_size, rng=rng),
-            ToArray(),
-            Normalize(mean, std),
+            FusedToArrayNormalize(mean, std),
         ]
     )
     aug_tf = Compose(
@@ -513,10 +516,7 @@ def _officehome_datasets(cfg: OfficeHomeConfig):
             Resize(cfg.img_resize),
             RandomCrop(cfg.img_crop_size, rng=rng),
             RandomHorizontalFlip(rng=rng),
-            ToArray(),
-            lambda a: random_affine(a, rng=rng),
-            gaussian_blur,
-            Normalize(mean, std),
+            FusedAffineBlurNormalize(mean, std, rng=rng),
         ]
     )
     source_ds = ImageFolderDataset(cfg.s_dset_path, transform=base_tf)

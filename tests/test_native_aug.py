@@ -1,0 +1,190 @@
+"""Native (C++) fused augmentation kernels: parity with the Python path.
+
+The native module fuses the per-item pixel tails of the input pipeline
+(reference semantics: ``resnet50_dwt_mec_officehome.py:481-492,535-543``):
+
+* ``normalize_from_u8``  == ToArray() -> Normalize(mean, std)
+* ``warp_affine_normalize_from_u8`` == ToArray -> cv2.warpAffine(m) ->
+  Normalize, with the blur no-op folded away.
+
+Tolerances: the normalize fusion is float32-exact; the warp is compared
+both against an exact float64 bilinear golden (tight) and against the
+cv2 path (loose — cv2 quantizes sample coordinates to 1/32 px).
+"""
+
+import numpy as np
+import pytest
+
+from dwt_tpu import native
+from dwt_tpu.data.transforms import (
+    Compose,
+    FusedAffineBlurNormalize,
+    FusedToArrayNormalize,
+    Normalize,
+    ToArray,
+    draw_affine_matrix,
+    gaussian_blur,
+    warp_affine,
+)
+
+MEAN = [0.485, 0.456, 0.406]
+STD = [0.229, 0.224, 0.225]
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def _img(h=61, w=53, c=3, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(h, w, c), dtype=np.uint8
+    )
+
+
+def _golden_warp_norm(a_u8, m, mean, std):
+    """Exact float64 reference of the fused op: invert m, bilinear with
+    zero border, /255, normalize."""
+    h, w, c = a_u8.shape
+    full = np.eye(3)
+    full[:2] = np.asarray(m, np.float64)
+    inv = np.linalg.inv(full)
+    ys, xs = np.mgrid[0:h, 0:w]
+    sx = inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]
+    sy = inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2]
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    fx = sx - x0
+    fy = sy - y0
+    out = np.zeros((h, w, c))
+    src = a_u8.astype(np.float64)
+    for dy, dx, wgt in (
+        (0, 0, (1 - fx) * (1 - fy)),
+        (0, 1, fx * (1 - fy)),
+        (1, 0, (1 - fx) * fy),
+        (1, 1, fx * fy),
+    ):
+        yy, xx = y0 + dy, x0 + dx
+        inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        vals = np.where(
+            inb[..., None],
+            src[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)],
+            0.0,
+        )
+        out += wgt[..., None] * vals
+    return (out / 255.0 - np.asarray(mean)) / np.asarray(std)
+
+
+@needs_native
+def test_normalize_from_u8_matches_python_chain():
+    a = _img()
+    got = native.normalize_from_u8(a, np.float32(MEAN), np.float32(STD))
+    want = Normalize(MEAN, STD)(ToArray()(a))
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@needs_native
+@pytest.mark.parametrize("sigma", [0.1, 0.3])
+def test_warp_norm_matches_float64_golden(sigma):
+    a = _img(97, 89)
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        m = draw_affine_matrix(rng, sigma)
+        got = native.warp_affine_normalize_from_u8(
+            a, m, np.float32(MEAN), np.float32(STD)
+        )
+        want = _golden_warp_norm(a, m, MEAN, STD)
+        # The kernel keeps sample coordinates in float32 (incremental
+        # per-row accumulation); a coordinate ulp propagates through
+        # 255-ranged pixel gradients and the /std scaling into ~1e-3
+        # worst-case on the normalized scale — 40x below cv2's own
+        # 1/32-px fixed-point quantization, and invisible to training.
+        np.testing.assert_allclose(got, want, atol=1.5e-3)
+
+
+@needs_native
+def test_warp_norm_close_to_cv2_path():
+    a = _img(128, 128)
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        m = draw_affine_matrix(rng)
+        got = native.warp_affine_normalize_from_u8(
+            a, m, np.float32(MEAN), np.float32(STD)
+        )
+        want = (
+            warp_affine(a.astype(np.float32) / 255.0, m)
+            - np.float32(MEAN)
+        ) / np.float32(STD)
+        d = np.abs(got - want)
+        # cv2 uses 1/32-px fixed-point sample coordinates; bounded by the
+        # max per-pixel jump (~1/255-ranged gradients / std).
+        assert d.max() < 0.05 and d.mean() < 2e-3
+
+
+@needs_native
+def test_warp_zero_border_normalizes_zero():
+    # Strong zoom-in: the destination corners sample far outside the
+    # source and must be exactly (0 - mean)/std, matching
+    # warp(border=0) -> normalize order.
+    a = _img(64, 64)
+    m = np.float32([[4.0, 0, 0], [0, 4.0, 0]])  # dst covers src/4 region
+    got = native.warp_affine_normalize_from_u8(
+        a, m, np.float32(MEAN), np.float32(STD)
+    )
+    # inverse maps dst corner (63, 63) -> (15.75, 15.75): in bounds; use
+    # a shifted matrix that pushes samples negative instead.
+    m2 = np.float32([[1.0, 0, 80.0], [0, 1.0, 80.0]])  # src shifted off
+    got2 = native.warp_affine_normalize_from_u8(
+        a, m2, np.float32(MEAN), np.float32(STD)
+    )
+    border = (0.0 - np.float32(MEAN)) / np.float32(STD)
+    np.testing.assert_allclose(got2[0, 0], border, atol=1e-6)
+    assert np.isfinite(got).all()
+
+
+@needs_native
+def test_fused_transforms_match_fallback_streams():
+    # Same seed: the fused class and the manual unfused chain must draw
+    # identical matrices and produce matching outputs (within the cv2
+    # fixed-point tolerance when cv2 backs warp_affine).
+    a = _img(96, 96, seed=5)
+
+    fused = FusedAffineBlurNormalize(
+        MEAN, STD, rng=np.random.default_rng(11)
+    )
+    got = fused(a)
+
+    rng = np.random.default_rng(11)
+    m = draw_affine_matrix(rng, 0.1)
+    want = Normalize(MEAN, STD)(
+        gaussian_blur(warp_affine(ToArray()(a), m))
+    )
+    assert np.abs(got - want).max() < 0.05
+
+    f2 = FusedToArrayNormalize(MEAN, STD)
+    np.testing.assert_allclose(
+        f2(a), Normalize(MEAN, STD)(ToArray()(a)), atol=1e-6
+    )
+
+
+def test_fused_transforms_work_without_native(monkeypatch):
+    # Force the fallback branch; outputs must be the plain Python chain.
+    monkeypatch.setattr(native, "available", lambda: False)
+    a = _img(48, 40, seed=9)
+    f = FusedToArrayNormalize(MEAN, STD)
+    np.testing.assert_allclose(
+        f(a), Normalize(MEAN, STD)(ToArray()(a)), atol=0
+    )
+    fused = FusedAffineBlurNormalize(MEAN, STD, rng=np.random.default_rng(2))
+    rng = np.random.default_rng(2)
+    m = draw_affine_matrix(rng, 0.1)
+    want = Normalize(MEAN, STD)(gaussian_blur(warp_affine(ToArray()(a), m)))
+    np.testing.assert_allclose(fused(a), want, atol=0)
+
+
+def test_fused_grayscale_falls_back():
+    # 2-D (PIL 'L'-mode) input isn't uint8 HWC — must route through the
+    # fallback and still return HWC float32 with a channel axis.
+    a = np.random.default_rng(1).integers(0, 256, (32, 32), dtype=np.uint8)
+    out = FusedToArrayNormalize([0.5], [0.5])(a)
+    assert out.shape == (32, 32, 1) and out.dtype == np.float32

@@ -26,15 +26,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dwt_tpu.data import (
     Compose,
     ImageFolderDataset,
-    Normalize,
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
     ThreadLocalRng,
-    ToArray,
     batch_iterator,
-    gaussian_blur,
-    random_affine,
 )
 
 MEAN = [0.485, 0.456, 0.406]
@@ -55,16 +51,20 @@ def write_synthetic_jpegs(root: str, n: int, size: int, classes: int = 2):
 
 
 def build_dataset(root: str, resize: int, crop: int, seed: int = 0):
+    # Mirrors dwt_tpu.train.loop._officehome_datasets — fused native
+    # (C++) pixel tails when available, numpy/cv2 fallback otherwise.
+    # A/B the two with DWT_DISABLE_NATIVE=1.
+    from dwt_tpu.data import FusedAffineBlurNormalize, FusedToArrayNormalize
+
     rng = ThreadLocalRng(seed)
     base_tf = Compose(
-        [Resize(resize), RandomCrop(crop, rng=rng), ToArray(),
-         Normalize(MEAN, STD)]
+        [Resize(resize), RandomCrop(crop, rng=rng),
+         FusedToArrayNormalize(MEAN, STD)]
     )
     aug_tf = Compose(
         [Resize(resize), RandomCrop(crop, rng=rng),
-         RandomHorizontalFlip(rng=rng), ToArray(),
-         lambda a: random_affine(a, rng=rng), gaussian_blur,
-         Normalize(MEAN, STD)]
+         RandomHorizontalFlip(rng=rng),
+         FusedAffineBlurNormalize(MEAN, STD, rng=rng)]
     )
     return ImageFolderDataset(root, transform=base_tf, transform_aug=aug_tf)
 
