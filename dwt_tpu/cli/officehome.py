@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")
+    p.add_argument("--steps_per_dispatch", type=int,
+                   default=d.steps_per_dispatch,
+                   help=">1: run k train steps per dispatch (lax.scan "
+                        "over k stacked batches; chunks cut at eval/"
+                        "checkpoint boundaries) — amortizes host "
+                        "dispatch latency; same numerics")
     p.add_argument("--init_ckpt", type=str, default=None,
                    help="read-only Orbax init artifact (written by "
                         "dwt-convert); unlike --ckpt_dir it is never "

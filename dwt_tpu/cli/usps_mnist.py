@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")
+    p.add_argument("--steps_per_dispatch", type=int,
+                   default=d.steps_per_dispatch,
+                   help=">1: run k train steps per dispatch (lax.scan "
+                        "over k stacked batches) — amortizes host "
+                        "dispatch latency; same numerics")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--bf16", action="store_true")

@@ -43,6 +43,10 @@ class DigitsConfig:
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
+    # >1: run k train steps per dispatch (lax.scan over k stacked
+    # batches) — amortizes the per-dispatch host round-trip; numerics
+    # match the single-step path (tests/test_train.py).
+    steps_per_dispatch: int = 1
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     bf16: bool = False
@@ -85,6 +89,10 @@ class OfficeHomeConfig:
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
+    # >1: k train steps per dispatch (lax.scan over k stacked batches);
+    # chunks are cut at eval/checkpoint boundaries so the check_acc_step
+    # and ckpt_every_iters cadences hold exactly.
+    steps_per_dispatch: int = 1
     init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000

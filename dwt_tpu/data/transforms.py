@@ -240,7 +240,9 @@ class FusedToArrayNormalize:
             and a.shape[-1] <= 16
         ):
             return native.normalize_from_u8(a, self.mean, self.std)
-        return self._fallback(img)
+        # Feed the already-converted array, not the PIL image — ToArray
+        # accepts numpy, and re-converting would copy the buffer twice.
+        return self._fallback(a)
 
 
 class FusedAffineBlurNormalize:
@@ -287,7 +289,7 @@ class FusedAffineBlurNormalize:
             return native.warp_affine_normalize_from_u8(
                 a, m, self.mean, self.std
             )
-        x = warp_affine(self.to_array(img), m)
+        x = warp_affine(self.to_array(a), m)
         return self.normalize(gaussian_blur(x, self.blur_sigma))
 
 

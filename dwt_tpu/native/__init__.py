@@ -86,16 +86,22 @@ def _build(out_path: str) -> None:
 
 def load():
     """The ctypes library handle, building it if needed; None on failure."""
-    global _lib, _load_attempted, _load_error
-    if _lib is not None:
+    global _lib, _load_attempted
+    # Lock-free fast path once the one-time attempt has CONCLUDED (either
+    # way): the Fused* transforms call this per item on every loader
+    # worker thread, and a mutex here would serialize exactly the
+    # fallback side of the DWT_DISABLE_NATIVE A/B.  _load_attempted is
+    # only set True after _load_locked finishes (under the lock), so a
+    # thread observing it True sees the final _lib value.
+    if _load_attempted:
         return _lib
     with _load_lock:
-        if _lib is not None:
-            return _lib
         if _load_attempted:
-            return None
-        _load_attempted = True
-        return _load_locked()
+            return _lib
+        try:
+            return _load_locked()
+        finally:
+            _load_attempted = True
 
 
 def _load_locked():
@@ -143,6 +149,14 @@ def _f32p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), a
 
 
+def _per_channel(v, c: int):
+    """mean/std as a length-``c`` f32 vector (numpy broadcast semantics —
+    a scalar or length-1 input applies to every channel, like
+    ``transforms.Normalize``); the C kernel indexes ``[0, c)``, so a
+    short buffer would be read past its end."""
+    return np.broadcast_to(np.asarray(v, np.float32).reshape(-1), (c,))
+
+
 def normalize_from_u8(
     a: np.ndarray, mean: np.ndarray, std: np.ndarray
 ) -> np.ndarray:
@@ -157,7 +171,11 @@ def normalize_from_u8(
         # uninitialized output instead of an error.
         raise ValueError(f"native kernels support 1..16 channels, got {c}")
     out = np.empty((h, w, c), np.float32)
-    (pm, _m), (ps, _s), (po, _o) = _f32p(mean), _f32p(std), _f32p(out)
+    (pm, _m), (ps, _s), (po, _o) = (
+        _f32p(_per_channel(mean, c)),
+        _f32p(_per_channel(std, c)),
+        _f32p(out),
+    )
     lib.dwt_norm_u8(
         a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.c_longlong(h * w),
@@ -183,7 +201,10 @@ def warp_affine_normalize_from_u8(
         raise ValueError(f"native kernels support 1..16 channels, got {c}")
     out = np.empty((h, w, c), np.float32)
     (pM, _M), (pm, _m), (ps, _s), (po, _o) = (
-        _f32p(m), _f32p(mean), _f32p(std), _f32p(out)
+        _f32p(m),
+        _f32p(_per_channel(mean, c)),
+        _f32p(_per_channel(std, c)),
+        _f32p(out),
     )
     lib.dwt_warp_affine_norm_u8(
         a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

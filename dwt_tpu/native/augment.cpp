@@ -115,15 +115,25 @@ void dwt_warp_affine_norm_u8(const uint8_t* src, int h, int w, int c,
                 }
             }
         }
-        int xfast0 = (int)lo;
-        while ((double)xfast0 < lo) ++xfast0;  // ceil
-        int xfast1 = (int)hi;
-        if ((double)xfast1 > hi) --xfast1;  // floor
-        if (xfast0 < 0) xfast0 = 0;
-        if (xfast1 >= w) xfast1 = w - 1;
-        if (xfast1 < xfast0) {
+        // Clamp in double BEFORE the int casts: a near-singular matrix
+        // (tiny slope above the 1e-12 guard, huge intercept) can push
+        // lo/hi far past INT_MAX, where (int)lo is undefined behavior
+        // and a ceil-by-increment loop would spin ~2^31 times.
+        if (lo < 0.0) lo = 0.0;
+        if (hi > (double)w - 1.0) hi = (double)w - 1.0;
+        int xfast0, xfast1;
+        if (hi < lo) {
             xfast0 = w;  // empty fast interval: all-checked row
             xfast1 = w - 1;
+        } else {
+            xfast0 = (int)lo;
+            if ((double)xfast0 < lo) ++xfast0;  // ceil, at most one step
+            xfast1 = (int)hi;  // floor for non-negative hi
+            if (xfast1 >= w) xfast1 = w - 1;
+            if (xfast1 < xfast0) {
+                xfast0 = w;
+                xfast1 = w - 1;
+            }
         }
 
         float sx = sx0, sy = sy0;
@@ -177,6 +187,15 @@ void dwt_warp_affine_norm_u8(const uint8_t* src, int h, int w, int c,
             }
             // Border segments: per-tap checks, zero outside.
             for (; x < xend; ++x, sx += i00, sy += i10) {
+                // All four taps miss the source (also catches NaN and the
+                // huge coordinates a near-singular matrix produces, whose
+                // float->int cast below would be undefined behavior).
+                if (!(sx > -1.0f && sx < (float)w &&
+                      sy > -1.0f && sy < (float)h)) {
+                    float* opix = orow + (long long)x * c;
+                    for (int k = 0; k < c; ++k) opix[k] = bias[k];
+                    continue;
+                }
                 const int x0 = (int)(sx >= 0.0f ? sx : sx - 1.0f);  // floor
                 const int y0 = (int)(sy >= 0.0f ? sy : sy - 1.0f);
                 const float fx = sx - (float)x0;

@@ -20,6 +20,7 @@ from dwt_tpu.parallel.mesh import (
     initialize_distributed,
 )
 from dwt_tpu.parallel.dp import (
+    make_sharded_scanned_step,
     make_sharded_train_step,
     shard_batch,
     replicate_state,
@@ -30,6 +31,7 @@ __all__ = [
     "DCN_AXIS",
     "make_mesh",
     "initialize_distributed",
+    "make_sharded_scanned_step",
     "make_sharded_train_step",
     "shard_batch",
     "replicate_state",

@@ -59,16 +59,50 @@ def make_sharded_train_step(
     return jax.jit(mapped) if jit else mapped
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
-    """Place every batch leaf with its leading axis sharded over the mesh.
+def make_sharded_scanned_step(
+    step_fn: Callable,
+    mesh: Mesh,
+    k: int,
+    jit: bool = True,
+) -> Callable:
+    """``make_sharded_train_step`` for a k-steps-per-dispatch chunk.
+
+    The chunk pytree carries ``[k, batch, ...]`` leaves: axis 0 is the
+    scan (time) axis — replicated — and axis 1 is the sample axis,
+    sharded exactly as the single-step path shards axis 0.  Inside the
+    shard_map the scan body is the same per-replica ``step_fn``, so all
+    three cross-replica collectives (moment pmean, grad averaging, metric
+    pmean) run per inner step, and numerics match k dispatched steps.
+    """
+    from dwt_tpu.train.steps import make_scanned_step
+
+    mapped = _shard_map(
+        make_scanned_step(step_fn, k),
+        mesh=mesh,
+        in_specs=(P(), _chunk_spec(mesh)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
+def _chunk_spec(mesh: Mesh) -> P:
+    """Chunk leaves are ``[k, batch, ...]``: scan axis replicated, sample
+    axis sharded over every mesh axis."""
+    return P(None, tuple(mesh.axis_names))
+
+
+def shard_batch(batch: Any, mesh: Mesh, chunked: bool = False) -> Any:
+    """Place every batch leaf with its leading axis sharded over the mesh
+    (``chunked=True``: the SECOND axis — leaf layout ``[k, batch, ...]``).
 
     Single-process: a plain sharded ``device_put``.  Multi-host (the mesh
     spans devices of several processes): every process passes its LOCAL
     shard — the slice its ``batch_iterator(shard=(process_index,
     process_count))`` produced — and the leaves are assembled into global
-    arrays whose leading axis is the concatenation over processes.
+    arrays whose sharded axis is the concatenation over processes.
     """
-    sharding = NamedSharding(mesh, _batch_spec(mesh))
+    spec = _chunk_spec(mesh) if chunked else _batch_spec(mesh)
+    sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     import numpy as np

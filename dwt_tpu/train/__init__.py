@@ -14,7 +14,9 @@ from dwt_tpu.train.steps import (
     make_digits_train_step,
     make_eval_step,
     make_officehome_train_step,
+    make_scanned_step,
     make_stat_collection_step,
+    stack_batches,
 )
 
 __all__ = [
@@ -26,5 +28,7 @@ __all__ = [
     "make_digits_train_step",
     "make_eval_step",
     "make_officehome_train_step",
+    "make_scanned_step",
     "make_stat_collection_step",
+    "stack_batches",
 ]

@@ -51,7 +51,9 @@ from dwt_tpu.train.steps import (
     make_digits_train_step,
     make_eval_step,
     make_officehome_train_step,
+    make_scanned_step,
     make_stat_collection_step,
+    stack_batches,
 )
 from dwt_tpu.utils import MetricLogger, latest_step, restore_state, save_state
 
@@ -171,8 +173,14 @@ def _process_shard() -> Optional[Tuple[int, int]]:
     return None
 
 
-def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callable]:
-    """Build (model, wrap_step, wrap_batch) for single-device or DP runs.
+def _maybe_dp(cfg, step_fn_builder, model_kw):
+    """Build ``(model, wrap_step, wrap_batch, (make_chunked, wrap_chunk))``
+    for single-device or DP runs.
+
+    ``make_chunked(raw_step, k)`` compiles a k-steps-per-dispatch variant
+    (lax.scan over ``[k, batch, ...]`` chunks) and ``wrap_chunk`` places a
+    stacked chunk (sample axis sharded on the DP path) — the
+    ``steps_per_dispatch`` machinery.
 
     The returned ``model`` carries the mesh ``axis_name`` when DP is on, so
     it must only be used *inside* the sharded step — init must go through an
@@ -196,11 +204,15 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
                 "sharded path"
             )
         model = step_fn_builder(axis_name=None, **model_kw)
-        return model, jax.jit, jax.device_put
+        make_chunked = lambda fn, k: jax.jit(
+            make_scanned_step(fn, k), donate_argnums=0
+        )
+        return model, jax.jit, jax.device_put, (make_chunked, jax.device_put)
     from dwt_tpu.parallel import (
         DATA_AXIS,
         DCN_AXIS,
         make_mesh,
+        make_sharded_scanned_step,
         make_sharded_train_step,
         shard_batch,
     )
@@ -223,7 +235,47 @@ def _maybe_dp(cfg, step_fn_builder, model_kw) -> Tuple[object, Callable, Callabl
         axis_name = DATA_AXIS
     model = step_fn_builder(axis_name=axis_name, **model_kw)
     wrap = lambda fn: make_sharded_train_step(fn, mesh)
-    return model, wrap, lambda b: shard_batch(b, mesh)
+    make_chunked = lambda fn, k: make_sharded_scanned_step(fn, mesh, k)
+    wrap_chunk = lambda c: shard_batch(c, mesh, chunked=True)
+    return model, wrap, lambda b: shard_batch(b, mesh), (make_chunked, wrap_chunk)
+
+
+def _chunk_stream(batches, k: int, should_cut=None, start: int = 0):
+    """Group host batches into stacked ``[<=k, ...]`` pytrees for the
+    steps-per-dispatch path.  ``should_cut(global_index)`` forces an early
+    cut so per-step cadences (eval every ``check_acc_step``, checkpoint
+    every ``ckpt_every_iters``) land exactly on chunk boundaries; the
+    stream end yields whatever remainder is pending."""
+    chunk = []
+    i = start
+    for b in batches:
+        chunk.append(b)
+        if len(chunk) == k or (should_cut is not None and should_cut(i)):
+            yield stack_batches(chunk)
+            chunk = []
+        i += 1
+    if chunk:
+        yield stack_batches(chunk)
+
+
+def _chunk_len(chunk) -> int:
+    return jax.tree.leaves(chunk)[0].shape[0]
+
+
+def _run_chunks(state, chunks, raw_step, make_chunked, fns, on_steps):
+    """Drive the steps-per-dispatch path: dispatch each stacked chunk,
+    compiling one scanned step per distinct chunk length (cached in
+    ``fns``, which the caller owns so the cache survives epochs), then
+    hand ``(state, n, stacked_metrics)`` to ``on_steps`` for per-inner-
+    step logging and boundary actions.  Shared by both training loops."""
+    for chunk in chunks:
+        n = _chunk_len(chunk)
+        fn = fns.get(n)
+        if fn is None:
+            fn = fns[n] = make_chunked(raw_step, n)
+        state, ms = fn(state, chunk)
+        on_steps(state, n, ms)
+    return state
 
 
 def _params_digest(state: TrainState) -> float:
@@ -384,7 +436,9 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             use_pallas=cfg.pallas_whiten,
         )
 
-    model, wrap, wrap_batch = _maybe_dp(cfg, build_model, {})
+    model, wrap, wrap_batch, (make_chunked, wrap_chunk) = _maybe_dp(
+        cfg, build_model, {}
+    )
     sample = jnp.zeros((2, bs, 28, 28, 1), jnp.float32)
     # Init with an axis-free twin: identical param/stat shapes, no pmean
     # traced outside the mesh (see _maybe_dp docstring).
@@ -397,15 +451,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         start_epoch = int(state.step) // steps_per_epoch
         logger.log("resume", int(state.step), epoch=start_epoch)
 
-    train_step = wrap(
-        make_digits_train_step(
-            model,
-            tx,
-            cfg.lambda_entropy_loss,
-            axis_name=getattr(model, "axis_name", None),
-        )
+    raw_step = make_digits_train_step(
+        model,
+        tx,
+        cfg.lambda_entropy_loss,
+        axis_name=getattr(model, "axis_name", None),
     )
+    train_step = wrap(raw_step)
     eval_step = jax.jit(make_eval_step(build_model(axis_name=None)))
+    k_dispatch = max(1, cfg.steps_per_dispatch)
+    chunk_fns = {}  # chunk length -> compiled scanned step
 
     if start_epoch >= cfg.epochs:
         # Resumed from a finished run: report the restored model's accuracy
@@ -442,19 +497,55 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         # Host-side batch assembly overlaps device compute: the prefetch
         # thread stages (and places) the next batches while the step runs;
         # item decode/augment parallelism lives in batch_iterator's pool.
-        batches = prefetch_to_device(
-            epoch_batches(), size=2, transfer=wrap_batch
-        )
-        for i, batch in enumerate(batches):
-            state, metrics = train_step(state, batch)
-            if i % cfg.log_interval == 0:
-                logger.log(
-                    "train",
-                    int(state.step),
-                    epoch=epoch,
-                    cls_loss=metrics["cls_loss"],
-                    entropy_loss=metrics["entropy_loss"],
-                )
+        if k_dispatch == 1:
+            batches = prefetch_to_device(
+                epoch_batches(), size=2, transfer=wrap_batch
+            )
+            for i, batch in enumerate(batches):
+                state, metrics = train_step(state, batch)
+                if i % cfg.log_interval == 0:
+                    logger.log(
+                        "train",
+                        int(state.step),
+                        epoch=epoch,
+                        cls_loss=metrics["cls_loss"],
+                        entropy_loss=metrics["entropy_loss"],
+                    )
+        else:
+            # k steps per dispatch: scan over stacked batches; metrics
+            # come back [n]-stacked so the log cadence is unchanged.
+            # Step numbers come from a host-side counter — reading
+            # int(st.step) every chunk would sync the host on the whole
+            # chunk and re-open the dispatch gap this path removes.
+            pos = 0
+            step0 = int(state.step)
+
+            def on_steps(st, n, ms):
+                nonlocal pos
+                for j in range(pos, pos + n):
+                    if j % cfg.log_interval == 0:
+                        jj = j - pos
+                        logger.log(
+                            "train",
+                            step0 + j + 1,
+                            epoch=epoch,
+                            cls_loss=ms["cls_loss"][jj],
+                            entropy_loss=ms["entropy_loss"][jj],
+                        )
+                pos += n
+
+            state = _run_chunks(
+                state,
+                prefetch_to_device(
+                    _chunk_stream(epoch_batches(), k_dispatch),
+                    size=2,
+                    transfer=wrap_chunk,
+                ),
+                raw_step,
+                make_chunked,
+                chunk_fns,
+                on_steps,
+            )
         result = _evaluate(
             eval_step, state, target_test_ds, cfg.test_batch_size,
             num_workers=cfg.num_workers,
@@ -558,7 +649,9 @@ def run_officehome(
             remat=cfg.remat,
         )
 
-    model, wrap, wrap_batch = _maybe_dp(cfg, build_model, {})
+    model, wrap, wrap_batch, (make_chunked, wrap_chunk) = _maybe_dp(
+        cfg, build_model, {}
+    )
     size = cfg.img_crop_size
     sample = jnp.zeros((3, bs, size, size, 3), jnp.float32)
     # Axis-free init twin (see _maybe_dp docstring).
@@ -606,14 +699,13 @@ def run_officehome(
         best_acc = _read_best_record(cfg.ckpt_dir)
         logger.log("resume", start_iter)
 
-    train_step = wrap(
-        make_officehome_train_step(
-            model,
-            tx,
-            cfg.lambda_mec_loss,
-            axis_name=getattr(model, "axis_name", None),
-        )
+    raw_step = make_officehome_train_step(
+        model,
+        tx,
+        cfg.lambda_mec_loss,
+        axis_name=getattr(model, "axis_name", None),
     )
+    train_step = wrap(raw_step)
     eval_model = build_model(axis_name=None)
     eval_step = jax.jit(make_eval_step(eval_model))
     collect_step = jax.jit(make_stat_collection_step(eval_model, num_domains=3))
@@ -642,23 +734,17 @@ def run_officehome(
                 "target_aug_x": np.asarray(tx_aug, np.float32),
             }
 
-    # Overlap host-side decode/augmentation with device compute (the aug
-    # pipeline is the expensive host stage for OfficeHome); the per-item
-    # decode/augment parallelism lives in batch_iterator's worker pool.
-    batches = prefetch_to_device(
-        train_batches(), size=2, transfer=wrap_batch
-    )
     acc = 0.0
-    for it, batch in enumerate(batches, start=start_iter):
-        state, metrics = train_step(state, batch)
+
+    def _log_train(it, step_no, cls, mec):
         if it % cfg.log_interval == 0:
-            logger.log(
-                "train",
-                int(state.step),
-                iter=it,
-                cls_loss=metrics["cls_loss"],
-                mec_loss=metrics["mec_loss"],
-            )
+            logger.log("train", step_no, iter=it, cls_loss=cls, mec_loss=mec)
+
+    def _boundary_actions(it):
+        # Runs after the step at global index ``it``; with
+        # steps_per_dispatch > 1, _chunk_stream cuts chunks at exactly
+        # these indices so the cadences match the per-step loop.
+        nonlocal acc, best_acc, state
         if (it + 1) % cfg.check_acc_step == 0:
             result = _evaluate(
                 eval_step, state, test_ds, cfg.test_batch_size,
@@ -681,6 +767,62 @@ def run_officehome(
                 logger.log("best", int(state.step), accuracy=acc)
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
             save_state(cfg.ckpt_dir, int(state.step), state)
+
+    # Overlap host-side decode/augmentation with device compute (the aug
+    # pipeline is the expensive host stage for OfficeHome); the per-item
+    # decode/augment parallelism lives in batch_iterator's worker pool.
+    k_dispatch = max(1, cfg.steps_per_dispatch)
+    if k_dispatch == 1:
+        batches = prefetch_to_device(
+            train_batches(), size=2, transfer=wrap_batch
+        )
+        for it, batch in enumerate(batches, start=start_iter):
+            state, metrics = train_step(state, batch)
+            _log_train(
+                it, int(state.step), metrics["cls_loss"], metrics["mec_loss"]
+            )
+            _boundary_actions(it)
+    else:
+        # Checkpoint boundaries only matter when checkpointing is on —
+        # cutting at them anyway would compile an extra odd-length
+        # scanned program for a save that never happens.
+        should_cut = lambda i: (
+            (i + 1) % cfg.check_acc_step == 0
+            or (cfg.ckpt_dir and (i + 1) % cfg.ckpt_every_iters == 0)
+        )
+        it = start_iter
+        # Host-side step numbering: int(st.step) per chunk would sync the
+        # host on the whole chunk and re-open the dispatch gap.
+        step0 = int(state.step) - start_iter
+
+        def on_steps(st, n, ms):
+            nonlocal it, state
+            state = st  # _boundary_actions evaluates/saves the live state
+            for j in range(n):
+                if (it + j) % cfg.log_interval == 0:
+                    _log_train(
+                        it + j,
+                        step0 + it + j + 1,
+                        ms["cls_loss"][j],
+                        ms["mec_loss"][j],
+                    )
+            it += n
+            _boundary_actions(it - 1)
+
+        state = _run_chunks(
+            state,
+            prefetch_to_device(
+                _chunk_stream(
+                    train_batches(), k_dispatch, should_cut, start=start_iter
+                ),
+                size=2,
+                transfer=wrap_chunk,
+            ),
+            raw_step,
+            make_chunked,
+            {},
+            on_steps,
+        )
 
     # Release the abandoned infinite streams' worker pools and in-flight
     # decoded batches before the stat-collection/eval phase.

@@ -18,9 +18,11 @@ from dwt_tpu.train import (
     create_train_state,
     make_digits_train_step,
     make_eval_step,
+    make_scanned_step,
     make_stat_collection_step,
     multistep_schedule,
     sgd_two_group,
+    stack_batches,
 )
 
 
@@ -114,6 +116,78 @@ def test_train_step_threads_through_scan(digits_setup):
         np.asarray(jax.tree.leaves(final.batch_stats)[0]),
         np.asarray(jax.tree.leaves(state.batch_stats)[0]),
     )
+
+
+def test_scanned_step_matches_sequential(digits_setup):
+    """k steps per dispatch (make_scanned_step) must reproduce k
+    dispatched steps: same params, same stats, same per-step metrics —
+    only the dispatch granularity may differ (steps_per_dispatch
+    contract, dwt_tpu/train/steps.py)."""
+    model, _, _, _, _ = digits_setup
+    # SGD, not Adam: Adam's first-step update is lr*sign(grad), so an
+    # ulp-level gradient difference between two differently-fused XLA
+    # programs (scan body vs standalone jit) flips near-zero grad signs
+    # into 2*lr param differences — noise amplification, not semantics.
+    # Under SGD the same ulp noise stays ulp-sized and the comparison is
+    # meaningful.  (Loss/metric parity below is exact either way.)
+    tx = optax.sgd(1e-2)
+    state = create_train_state(
+        model,
+        jax.random.key(0),
+        jnp.stack(
+            [jnp.zeros((8, 28, 28, 1)), jnp.zeros((8, 28, 28, 1))]
+        ),
+        tx,
+    )
+    step = jax.jit(make_digits_train_step(model, tx, lambda_entropy=0.1))
+
+    host_batches = []
+    for s in range(3):
+        sx, sy = _synthetic_digits(8, seed=10 + s)
+        txi, _ = _synthetic_digits(8, seed=20 + s)
+        host_batches.append(
+            {
+                "source_x": np.asarray(sx),
+                "source_y": np.asarray(sy),
+                "target_x": np.asarray(txi),
+            }
+        )
+
+    seq_state = state
+    seq_metrics = []
+    for b in host_batches:
+        seq_state, m = step(seq_state, b)
+        seq_metrics.append(m)
+
+    scanned = jax.jit(make_scanned_step(step, 3))
+    scan_state, ms = scanned(state, stack_batches(host_batches))
+
+    assert int(scan_state.step) == int(seq_state.step)
+    for a, b in zip(
+        jax.tree.leaves(scan_state.params), jax.tree.leaves(seq_state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+    for a, b in zip(
+        jax.tree.leaves(scan_state.batch_stats),
+        jax.tree.leaves(seq_state.batch_stats),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+    for j, m in enumerate(seq_metrics):
+        for key in m:
+            np.testing.assert_allclose(
+                np.asarray(ms[key][j]), np.asarray(m[key]),
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+def test_scanned_step_rejects_bad_k(digits_setup):
+    model, _, _, step, _ = digits_setup
+    with pytest.raises(ValueError):
+        make_scanned_step(step, 0)
 
 
 def test_stat_collection_updates_only_stats(digits_setup):
