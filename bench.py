@@ -110,18 +110,13 @@ def _bench_lenet(steps: int, batch: int):
     return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
 
 
-def _bench_resnet50(steps: int, batch: int, image: int = 224,
-                    use_pallas: bool = False):
+def _build_resnet50(batch: int, image: int, use_pallas: bool):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from dwt_tpu.nn import ResNetDWT
-    from dwt_tpu.train import (
-        create_train_state,
-        make_officehome_train_step,
-        sgd_two_group,
-    )
+    from dwt_tpu.train import create_train_state, sgd_two_group
 
     rng = np.random.default_rng(0)
     b = {
@@ -143,10 +138,43 @@ def _bench_resnet50(steps: int, batch: int, image: int = 224,
     tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
+    return model, tx, state, b
+
+
+def _bench_resnet50(steps: int, batch: int, image: int = 224,
+                    use_pallas: bool = False):
+    import jax
+
+    from dwt_tpu.train import make_officehome_train_step
+
+    model, tx, state, b = _build_resnet50(batch, image, use_pallas)
     step = jax.jit(
         make_officehome_train_step(model, tx, 0.1), donate_argnums=0
     )
     return _time_steps(step, state, b, steps, imgs_per_step=3 * batch)
+
+
+def _bench_resnet50_eval(steps: int, batch: int, image: int = 224):
+    """Inference throughput of the eval path — the reference ``test()``
+    loop (``resnet50_dwt_mec_officehome.py:447-464``): target-branch-only
+    forward with running stats, batched argmax/nll counters."""
+    import jax
+
+    from dwt_tpu.train import make_eval_step
+
+    model, _, state, b = _build_resnet50(batch, image, use_pallas=False)
+    estep = make_eval_step(model)
+
+    # Shim to the (state, batch) -> (state, {"loss": ...}) shape the
+    # shared timing helpers expect; params/stats ride inside `state`.
+    def step(s, batch_):
+        m = estep(s.params, s.batch_stats, batch_["target_x"],
+                  batch_["source_y"])
+        return s, {"loss": m["loss_sum"]}
+
+    return _time_steps(
+        jax.jit(step), state, b, steps, imgs_per_step=batch
+    )
 
 
 def _compile_with_flops(step, state, batch):
@@ -470,6 +498,8 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         model_args = ["--model", "resnet50", "--image", "96", "--batch", "4"]
         if args.pallas:  # keep the requested A/B variant in the fallback
             model_args.append("--pallas")
+        if args.phase != "train":
+            model_args += ["--phase", args.phase]
         steps = min(args.steps, 5)
     cmd = [
         sys.executable,
@@ -510,6 +540,13 @@ def main():
         "on TPU to decide PERF.md's go/no-go at full-step level",
     )
     ap.add_argument(
+        "--phase",
+        choices=["train", "eval"],
+        default="train",
+        help="train = fwd+bwd+update (the flagship metric); eval = the "
+        "inference test() path (target branch, running stats)",
+    )
+    ap.add_argument(
         "--no-probe",
         action="store_true",
         help="skip the subprocess backend probe (fallback path)",
@@ -518,6 +555,10 @@ def main():
     args = ap.parse_args()
     if args.pallas and args.model != "resnet50":
         ap.error("--pallas only applies to --model resnet50")
+    if args.pallas and args.phase == "eval":
+        ap.error("--pallas is a training-path A/B; use --phase train")
+    if args.phase == "eval" and args.model != "resnet50":
+        ap.error("--phase eval is implemented for --model resnet50")
 
     if not args.no_probe:
         # The subprocess jax probe is AUTHORITATIVE; the TCP port poll is
@@ -560,14 +601,18 @@ def main():
         metric = "lenet_dwt_train_imgs_per_sec"
     else:
         batch = args.batch or 18
-        imgs_per_sec, step_time, flops, degraded, tinfo = _bench_resnet50(
-            args.steps, batch, args.image, use_pallas=args.pallas
-        )
-        metric = (
-            "resnet50_dwt_train_imgs_per_sec"
-            if args.image == 224
-            else f"resnet50_dwt_{args.image}px_train_imgs_per_sec"
-        )
+        if args.phase == "eval":
+            (imgs_per_sec, step_time, flops, degraded, tinfo) = (
+                _bench_resnet50_eval(args.steps, batch, args.image)
+            )
+        else:
+            (imgs_per_sec, step_time, flops, degraded, tinfo) = (
+                _bench_resnet50(
+                    args.steps, batch, args.image, use_pallas=args.pallas
+                )
+            )
+        px = "" if args.image == 224 else f"{args.image}px_"
+        metric = f"resnet50_dwt_{px}{args.phase}_imgs_per_sec"
         if args.pallas:
             metric += "_pallas"
 
@@ -576,6 +621,9 @@ def main():
         flops_source = "analytic_estimate"
         n_imgs = (2 if args.model == "lenet" else 3) * batch
         per_img = _ANALYTIC_TRAIN_FLOPS_PER_IMG[args.model]
+        if args.phase == "eval":
+            n_imgs = batch
+            per_img /= 3  # fwd only (train ~= 3x fwd)
         if args.model == "resnet50" and args.image != 224:
             per_img *= (args.image / 224) ** 2  # conv FLOPs scale with area
         flops = per_img * n_imgs

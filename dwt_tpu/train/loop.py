@@ -772,15 +772,22 @@ def run_officehome(
     # pipeline is the expensive host stage for OfficeHome); the per-item
     # decode/augment parallelism lives in batch_iterator's worker pool.
     k_dispatch = max(1, cfg.steps_per_dispatch)
+    # Host-side step numbering for train logs: int(state.step) inside the
+    # hot loop would block on the just-dispatched step every iteration,
+    # destroying async-dispatch pipelining; the count is fully determined
+    # host-side as step0 + iter + 1.
+    step0 = int(state.step) - start_iter
     if k_dispatch == 1:
         batches = prefetch_to_device(
             train_batches(), size=2, transfer=wrap_batch
         )
         for it, batch in enumerate(batches, start=start_iter):
             state, metrics = train_step(state, batch)
-            _log_train(
-                it, int(state.step), metrics["cls_loss"], metrics["mec_loss"]
-            )
+            if it % cfg.log_interval == 0:
+                _log_train(
+                    it, step0 + it + 1,
+                    metrics["cls_loss"], metrics["mec_loss"],
+                )
             _boundary_actions(it)
     else:
         # Checkpoint boundaries only matter when checkpointing is on —
@@ -791,9 +798,6 @@ def run_officehome(
             or (cfg.ckpt_dir and (i + 1) % cfg.ckpt_every_iters == 0)
         )
         it = start_iter
-        # Host-side step numbering: int(st.step) per chunk would sync the
-        # host on the whole chunk and re-open the dispatch gap.
-        step0 = int(state.step) - start_iter
 
         def on_steps(st, n, ms):
             nonlocal it, state
