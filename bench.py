@@ -110,7 +110,11 @@ def _bench_lenet(steps: int, batch: int):
     return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
 
 
-def _build_resnet50(batch: int, image: int, use_pallas: bool):
+def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
+    """Model/state/batch for the flagship benchmarks.  ``tx`` defaults to
+    the reference SGD recipe; the eval bench passes ``optax.identity()``
+    so no momentum buffers (a full extra param copy in HBM) are
+    allocated for an inference measurement."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -135,7 +139,8 @@ def _build_resnet50(batch: int, image: int, use_pallas: bool):
         num_classes=65, group_size=4, dtype=jnp.bfloat16,
         use_pallas=use_pallas,
     )
-    tx = sgd_two_group(1e-2, 1e-3)
+    if tx is None:
+        tx = sgd_two_group(1e-2, 1e-3)
     sample = jnp.stack([b["source_x"], b["target_x"], b["target_aug_x"]])
     state = create_train_state(model, jax.random.key(0), sample, tx)
     return model, tx, state, b
@@ -159,10 +164,13 @@ def _bench_resnet50_eval(steps: int, batch: int, image: int = 224):
     loop (``resnet50_dwt_mec_officehome.py:447-464``): target-branch-only
     forward with running stats, batched argmax/nll counters."""
     import jax
+    import optax
 
     from dwt_tpu.train import make_eval_step
 
-    model, _, state, b = _build_resnet50(batch, image, use_pallas=False)
+    model, _, state, b = _build_resnet50(
+        batch, image, use_pallas=False, tx=optax.identity()
+    )
     estep = make_eval_step(model)
 
     # Shim to the (state, batch) -> (state, {"loss": ...}) shape the

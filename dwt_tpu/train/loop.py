@@ -737,8 +737,9 @@ def run_officehome(
     acc = 0.0
 
     def _log_train(it, step_no, cls, mec):
-        if it % cfg.log_interval == 0:
-            logger.log("train", step_no, iter=it, cls_loss=cls, mec_loss=mec)
+        # Callers guard on the log cadence BEFORE evaluating the metric
+        # args (device slices); this helper only owns the record shape.
+        logger.log("train", step_no, iter=it, cls_loss=cls, mec_loss=mec)
 
     def _boundary_actions(it):
         # Runs after the step at global index ``it``; with

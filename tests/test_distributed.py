@@ -63,6 +63,11 @@ def test_two_process_distributed_digits(tmp_path):
                     sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
                     "--synthetic", "--synthetic_size", "64",
                     "--distributed", "--data_parallel",
+                    # Also exercises the multi-host chunked path:
+                    # [k, batch, ...] chunks through shard_batch(
+                    # chunked=True) -> make_array_from_process_local_data
+                    # with the (None, mesh-axes) spec.
+                    "--steps_per_dispatch", "2",
                     "--epochs", "1",
                     "--group_size", "4",
                     "--source_batch_size", "8",
