@@ -77,7 +77,7 @@ def batch_norm(
     ``momentum=None`` → cumulative average factor ``1/count`` like the
     reference's ``batch_norm.py:61-64``.
     """
-    xf = x.astype(jnp.float32)
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     if train:
         reduce_axes = tuple(range(x.ndim - 1))
         n = 1
@@ -94,9 +94,9 @@ def batch_norm(
 
         count = stats.count + 1
         if momentum is None:
-            factor = 1.0 / count.astype(jnp.float32)
+            factor = 1.0 / count.astype(xf.dtype)
         else:
-            factor = jnp.float32(momentum)
+            factor = jnp.asarray(momentum, xf.dtype)
         # Unbiased variance feeds the EMA (torch F.batch_norm convention).
         unbiased = var * (n / max(n - 1, 1))
         new_stats = BatchNormStats(

@@ -1,6 +1,8 @@
 """Domain-adaptation losses (closed forms from SURVEY §2.2 rows 3-4).
 
-All losses compute in float32 regardless of input dtype.
+All losses compute in at least float32: lower-precision logits (bf16) are
+promoted to f32; f64 passes through untruncated (used by the f64 lockstep
+trajectory-parity tests).
 """
 
 from __future__ import annotations
@@ -9,13 +11,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _at_least_f32(x: jax.Array) -> jax.Array:
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+
 def entropy_loss(logits: jax.Array) -> jax.Array:
     """Mean Shannon entropy of softmax predictions.
 
     ``-mean_n sum_k p_nk log p_nk`` — the target-entropy-minimization term of
     the digits experiment (reference ``usps_mnist.py:183-194``).
     """
-    logits = logits.astype(jnp.float32)
+    logits = _at_least_f32(logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
     p = jnp.exp(logp)
     return -jnp.mean(jnp.sum(p * logp, axis=-1))
@@ -27,8 +33,8 @@ def mec_loss(logits_a: jax.Array, logits_b: jax.Array) -> jax.Array:
     Per sample: ``min_k 0.5 * (-log p_a(k) - log p_b(k))``, then batch mean
     (reference ``utils/consensus_loss.py:11-24``).
     """
-    la = jax.nn.log_softmax(logits_a.astype(jnp.float32), axis=-1)
-    lb = jax.nn.log_softmax(logits_b.astype(jnp.float32), axis=-1)
+    la = jax.nn.log_softmax(_at_least_f32(logits_a), axis=-1)
+    lb = jax.nn.log_softmax(_at_least_f32(logits_b), axis=-1)
     per_class = 0.5 * (-la - lb)  # [N, K]
     return jnp.mean(jnp.min(per_class, axis=-1))
 
@@ -38,7 +44,7 @@ def nll_loss(
 ) -> jax.Array:
     """Negative log likelihood of integer ``labels`` under ``log_probs``."""
     picked = jnp.take_along_axis(
-        log_probs.astype(jnp.float32), labels[:, None], axis=-1
+        _at_least_f32(log_probs), labels[:, None], axis=-1
     )[:, 0]
     if reduction == "mean":
         return -jnp.mean(picked)
@@ -53,7 +59,7 @@ def softmax_cross_entropy(
     """``nll(log_softmax(logits), labels)`` — the reference's cls loss
     (``usps_mnist.py:298``, ``resnet50_dwt_mec_officehome.py:425``)."""
     return nll_loss(
-        jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+        jax.nn.log_softmax(_at_least_f32(logits), axis=-1),
         labels,
         reduction,
     )

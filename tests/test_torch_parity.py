@@ -114,7 +114,13 @@ class _TorchLeNetDWT(nn.Module):
 
 
 def _t2n(t):
-    return t.detach().numpy().astype(np.float32)
+    # Preserves the twin's dtype: f32 for the forward/grad parity tests,
+    # f64 for the lockstep trajectory tests (under jax x64).  The copy is
+    # load-bearing: ``.numpy()`` returns a VIEW of the torch tensor, and
+    # ``jnp.asarray`` of a CPU numpy array can be zero-copy — without the
+    # copy, in-place torch optimizer updates would silently mutate the
+    # "tied" jax params after the fact.
+    return t.detach().numpy().copy()
 
 
 def _lenet_tree_from_torch(tm, get):
@@ -478,36 +484,10 @@ class _TorchResNetDWT(nn.Module):
 
 
 def test_full_tiny_resnet_matches_torch():
-    from dwt_tpu.nn import ResNetDWT
-
-    torch.manual_seed(2)
-    tm = _TorchResNetDWT(num_classes=7, group_size=4)
-    fm = ResNetDWT(stage_sizes=(1, 1, 1, 1), num_classes=7, group_size=4)
-
+    tm, fm, variables = _tied_tiny_resnet()
     n, hw = 2, 32
     rng = np.random.default_rng(4)
     x = rng.normal(size=(3, n, hw, hw, 3)).astype(np.float32)
-    variables = fm.init(jax.random.key(0), jnp.asarray(x), train=True)
-
-    params = dict(variables["params"])
-    params["conv1"] = {
-        "kernel": jnp.asarray(_t2n(tm.conv1.weight).transpose(2, 3, 1, 0))
-    }
-    params["dn1"] = {
-        "gamma": jnp.asarray(_t2n(tm.g1).reshape(-1)),
-        "beta": jnp.asarray(_t2n(tm.b1).reshape(-1)),
-    }
-    for stage, tblock in enumerate(tm.blocks, start=1):
-        name = f"layer{stage}_0"
-        sub = _tie_bottleneck(
-            tblock, {"params": params[name], "batch_stats": {}}
-        )
-        params[name] = sub["params"]
-    params["fc_out"] = {
-        "kernel": jnp.asarray(_t2n(tm.fc.weight).T),
-        "bias": jnp.asarray(_t2n(tm.fc.bias)),
-    }
-    variables = {"params": params, "batch_stats": variables["batch_stats"]}
 
     tm.train()
     with torch.no_grad():
@@ -533,6 +513,280 @@ def test_full_tiny_resnet_matches_torch():
     np.testing.assert_allclose(
         np.asarray(out_f), _t2n(out_t), rtol=1e-3, atol=5e-4
     )
+
+
+# ---------------------------------------- k-step trajectory parity
+# The strongest paper-parity evidence obtainable with zero datasets: run
+# the ACTUAL training recipes (optimizer included) in lockstep against the
+# torch twin for several steps and require the per-step losses, the final
+# parameters, and the final running stats to agree.  Per-op parity can't
+# pin optimizer semantics (bias correction, L2-before-moments ordering,
+# momentum init, pre-step MultiStepLR) — this does.
+#
+# Both sides run in FLOAT64: in f32 the trajectories are chaotic — ulp-level
+# gradient differences through the Cholesky chain get amplified by Adam's
+# sign normalization into lr-sized parameter moves within a handful of steps
+# (the same mechanism documented at ``train/steps.py:168-174``), so a tight
+# f32 lockstep comparison is impossible *in principle*.  In f64 the fp noise
+# sits ~9 orders below the updates and any observable divergence is a real
+# semantic mismatch (wrong decay ordering, missing bias correction, wrong lr
+# routing, EMA convention drift).
+
+
+def test_kstep_digits_trajectory_matches_torch_adam():
+    """k lockstep Adam steps of the digits recipe (``usps_mnist.py:281-308``,
+    Adam(lr=1e-3, weight_decay=5e-4) at ``:389``): per-step losses, final
+    params, and final whitening running stats must track the torch twin to
+    f64 tolerance."""
+    from dwt_tpu.train import adam_l2, make_digits_train_step
+    from dwt_tpu.train.state import TrainState
+
+    k, n, lr, wd = 6, 6, 1e-3, 5e-4
+
+    torch.manual_seed(0)
+    tm = _TorchLeNetDWT(group_size=4).double()
+    with torch.no_grad():
+        for g, b in [(tm.g1, tm.b1), (tm.g2, tm.b2), (tm.g3, tm.b3),
+                     (tm.g4, tm.b4), (tm.g5, tm.b5)]:
+            g.add_(0.1 * torch.randn_like(g))
+            b.add_(0.1 * torch.randn_like(b))
+    fm = LeNetDWT(group_size=4, dtype=jnp.float64)
+
+    rng = np.random.default_rng(21)
+    batches = []
+    for _ in range(k):
+        x = rng.normal(size=(2, n, 28, 28, 1))  # float64
+        y = rng.integers(0, 10, size=(n,))
+        batches.append((x, y))
+
+    with jax.enable_x64(True):
+        # Tie the flax model to the twin's PRE-training weights (f64 under
+        # x64), then let both sides free-run.
+        variables = fm.init(
+            jax.random.key(0), jnp.asarray(batches[0][0]), train=True
+        )
+        variables = _flax_variables_from_torch(tm, variables)
+
+        # torch side: the reference loop body verbatim, in double.
+        tm.train()
+        opt = torch.optim.Adam(tm.parameters(), lr=lr, weight_decay=wd)
+        want_losses = []
+        for x, y in batches:
+            opt.zero_grad()
+            out = tm(_torch_input(x))
+            src, tgt = out[:n], out[n:]
+            cls = F.nll_loss(F.log_softmax(src, dim=1), torch.from_numpy(y))
+            p = F.softmax(tgt, dim=1)
+            ent = torch.mean(torch.sum(-p * torch.log(p), dim=1))
+            loss = cls + 0.1 * ent
+            loss.backward()
+            opt.step()
+            want_losses.append(loss.item())
+
+        # jax side: the actual step factory + optimizer constructor.
+        tx = adam_l2(lr, weight_decay=wd)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables["batch_stats"],
+            opt_state=tx.init(variables["params"]),
+        )
+        step = jax.jit(make_digits_train_step(fm, tx, lambda_entropy=0.1))
+        got_losses = []
+        for x, y in batches:
+            batch = {
+                "source_x": jnp.asarray(x[0]),
+                "target_x": jnp.asarray(x[1]),
+                "source_y": jnp.asarray(y),
+            }
+            state, metrics = step(state, batch)
+            got_losses.append(float(metrics["loss"]))
+
+        np.testing.assert_allclose(
+            got_losses, want_losses, rtol=1e-8, atol=1e-10
+        )
+
+        # Final parameters: k optimizer updates deep, both frameworks must
+        # land on the same weights (pins bias correction + L2 ordering).
+        want_params = _lenet_tree_from_torch(tm, lambda p: p)
+
+        def compare(path, w, g):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-6, atol=1e-9,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+        jax.tree_util.tree_map_with_path(compare, want_params, state.params)
+
+        # Final running stats: k EMA advances driven by the evolving params.
+        stats = state.batch_stats
+        for i, wmod in ((1, tm.w1), (2, tm.w2)):
+            for d in range(2):
+                np.testing.assert_allclose(
+                    np.asarray(stats[f"dn{i}"]["whitening"].mean[d]),
+                    _t2n(wmod[d].running_mean).reshape(-1),
+                    rtol=1e-7, atol=1e-10,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(stats[f"dn{i}"]["whitening"].cov[d]),
+                    _t2n(wmod[d].running_cov),
+                    rtol=1e-7, atol=1e-10,
+                )
+
+
+def _tied_tiny_resnet(seed=2, double=False):
+    """Weight-tied (torch twin, flax model, variables) triple.  With
+    ``double=True`` the twin is f64 and the caller must be inside
+    ``jax.experimental.enable_x64()`` so the tied arrays stay f64."""
+    from dwt_tpu.nn import ResNetDWT
+
+    torch.manual_seed(seed)
+    tm = _TorchResNetDWT(num_classes=7, group_size=4)
+    if double:
+        tm = tm.double()
+    fm = ResNetDWT(
+        stage_sizes=(1, 1, 1, 1), num_classes=7, group_size=4,
+        dtype=jnp.float64 if double else jnp.float32,
+    )
+
+    n, hw = 2, 32
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(3, n, hw, hw, 3)).astype(np.float32)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x), train=True)
+
+    params = dict(variables["params"])
+    params["conv1"] = {
+        "kernel": jnp.asarray(_t2n(tm.conv1.weight).transpose(2, 3, 1, 0))
+    }
+    params["dn1"] = {
+        "gamma": jnp.asarray(_t2n(tm.g1).reshape(-1)),
+        "beta": jnp.asarray(_t2n(tm.b1).reshape(-1)),
+    }
+    for stage, tblock in enumerate(tm.blocks, start=1):
+        name = f"layer{stage}_0"
+        sub = _tie_bottleneck(
+            tblock, {"params": params[name], "batch_stats": {}}
+        )
+        params[name] = sub["params"]
+    params["fc_out"] = {
+        "kernel": jnp.asarray(_t2n(tm.fc.weight).T),
+        "bias": jnp.asarray(_t2n(tm.fc.bias)),
+    }
+    return tm, fm, {"params": params, "batch_stats": variables["batch_stats"]}
+
+
+def test_kstep_officehome_trajectory_matches_torch_sgd():
+    """k lockstep steps of the OfficeHome recipe on the tied tiny ResNet:
+    two-group SGD (head lr, backbone lr×0.1, momentum 0.9, L2 5e-4 —
+    ``resnet50_dwt_mec_officehome.py:578-590``) under a pre-step MultiStepLR
+    decay that FIRES mid-trajectory, loss = cls + 0.1·MEC (``:425``).
+    Pins momentum-buffer init, two-group routing, and the scheduler's
+    effective lr sequence through a real optimizer trajectory."""
+    import warnings
+
+    from dwt_tpu.train import (
+        make_officehome_train_step,
+        multistep_schedule,
+        sgd_two_group,
+    )
+    from dwt_tpu.train.state import TrainState
+
+    k, n, hw, lr, wd, mom = 5, 2, 32, 1e-2, 5e-4, 0.9
+
+    rng = np.random.default_rng(31)
+    batches = []
+    for _ in range(k):
+        x = rng.normal(size=(3, n, hw, hw, 3))  # float64
+        y = rng.integers(0, 7, size=(n,))
+        batches.append((x, y))
+
+    with jax.enable_x64(True):
+        tm, fm, variables = _tied_tiny_resnet(double=True)
+
+        # torch side: two param groups, pre-step scheduler (the reference's
+        # PyTorch-1.0 ordering — scheduler.step() before each iteration).
+        tm.train()
+        head = list(tm.fc.parameters())
+        head_ids = {id(p) for p in head}
+        backbone = [p for p in tm.parameters() if id(p) not in head_ids]
+        opt = torch.optim.SGD(
+            [{"params": head, "lr": lr},
+             {"params": backbone, "lr": lr * 0.1}],
+            momentum=mom, weight_decay=wd,
+        )
+        sched = torch.optim.lr_scheduler.MultiStepLR(
+            opt, milestones=[3], gamma=0.1
+        )
+        want_losses = []
+        for x, y in batches:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # pre-step order deliberate
+                sched.step()
+            opt.zero_grad()
+            out = tm(torch.from_numpy(np.ascontiguousarray(
+                x.reshape(-1, hw, hw, 3).transpose(0, 3, 1, 2)
+            )))
+            src, tgt, tga = out[:n], out[n:2 * n], out[2 * n:]
+            cls = F.nll_loss(F.log_softmax(src, dim=1), torch.from_numpy(y))
+            la = F.log_softmax(tgt, dim=1)
+            lb = F.log_softmax(tga, dim=1)
+            mec = torch.mean(torch.min(-0.5 * (la + lb), dim=1).values)
+            loss = cls + 0.1 * mec
+            loss.backward()
+            opt.step()
+            want_losses.append(loss.item())
+
+        # jax side: the loop's own schedule + optimizer constructors.
+        head_sched = multistep_schedule(lr, [3], 0.1, pre_step=True)
+        backbone_sched = multistep_schedule(lr * 0.1, [3], 0.1, pre_step=True)
+        tx = sgd_two_group(head_sched, backbone_sched, momentum=mom,
+                           weight_decay=wd)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables["batch_stats"],
+            opt_state=tx.init(variables["params"]),
+        )
+        step = jax.jit(make_officehome_train_step(fm, tx, lambda_mec=0.1))
+        got_losses = []
+        for x, y in batches:
+            batch = {
+                "source_x": jnp.asarray(x[0]),
+                "target_x": jnp.asarray(x[1]),
+                "target_aug_x": jnp.asarray(x[2]),
+                "source_y": jnp.asarray(y),
+            }
+            state, metrics = step(state, batch)
+            got_losses.append(float(metrics["loss"]))
+
+        np.testing.assert_allclose(
+            got_losses, want_losses, rtol=1e-8, atol=1e-10
+        )
+
+        # Final params after k momentum steps spanning the lr decay.
+        want_params = {}
+        want_params["conv1"] = {
+            "kernel": jnp.asarray(_t2n(tm.conv1.weight).transpose(2, 3, 1, 0))
+        }
+        want_params["dn1"] = {
+            "gamma": jnp.asarray(_t2n(tm.g1).reshape(-1)),
+            "beta": jnp.asarray(_t2n(tm.b1).reshape(-1)),
+        }
+        for stage, tblock in enumerate(tm.blocks, start=1):
+            sub = _tie_bottleneck(tblock, {"params": {}, "batch_stats": {}})
+            want_params[f"layer{stage}_0"] = sub["params"]
+        want_params["fc_out"] = {
+            "kernel": jnp.asarray(_t2n(tm.fc.weight).T),
+            "bias": jnp.asarray(_t2n(tm.fc.bias)),
+        }
+
+        def compare(path, w, g):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-6, atol=1e-9,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+        jax.tree_util.tree_map_with_path(compare, want_params, state.params)
 
 
 def test_gradients_match_torch(tied_models):
