@@ -50,6 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "dispatch latency; same numerics")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
+    p.add_argument("--guard_policy",
+                   choices=["none", "halt", "skip_step", "rollback"],
+                   default=d.guard_policy,
+                   help="divergence guard: on a non-finite loss/grad-norm, "
+                        "halt, skip back to the last good in-memory state, "
+                        "or roll back to the newest valid checkpoint with a "
+                        "re-seeded data order")
+    p.add_argument("--guard_interval", type=int, default=d.guard_interval,
+                   help="steps between guard finite-checks (each check is "
+                        "one host sync; NaN is absorbing, so detection is "
+                        "at most interval-1 steps late)")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--expect_accuracy", type=float, default=None,

@@ -50,6 +50,13 @@ class DigitsConfig:
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     bf16: bool = False
+    # Divergence guard (dwt_tpu.resilience): amortized finite-check on
+    # loss/grad-norm every guard_interval steps.  Policies: "none" (off),
+    # "halt", "skip_step" (revert to last in-memory good state),
+    # "rollback" (restore newest valid checkpoint, re-seeded data order).
+    guard_policy: str = "none"
+    guard_interval: int = 50
+    guard_max_rollbacks: int = 3
 
 
 @dataclasses.dataclass
@@ -98,3 +105,7 @@ class OfficeHomeConfig:
     ckpt_every_iters: int = 1000
     bf16: bool = False
     remat: bool = False  # jax.checkpoint per bottleneck (HBM for FLOPs)
+    # Divergence guard — see DigitsConfig.guard_policy.
+    guard_policy: str = "none"
+    guard_interval: int = 50
+    guard_max_rollbacks: int = 3

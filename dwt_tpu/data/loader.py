@@ -13,6 +13,7 @@ standard JAX double-buffering pattern.
 from __future__ import annotations
 
 import collections
+import logging
 import queue
 import threading
 from typing import Callable, Iterable, Iterator, Optional, Tuple
@@ -21,16 +22,53 @@ import numpy as np
 
 from dwt_tpu.data.transforms import set_item_seed
 
+log = logging.getLogger(__name__)
 
-def _load_item(dataset, i: int, token):
+# Default per-item retry count: one immediate retry covers the common
+# transient cases (NFS hiccup, racing file replacement) without stalling
+# the worker pool on a genuinely corrupt file.
+ITEM_RETRIES = 1
+
+# Sentinel yielded in place of an item that exhausted its retries under
+# quarantine semantics; batch assembly drops it.
+QUARANTINED = object()
+
+
+def _load_item(dataset, i: int, token, retries: int = ITEM_RETRIES,
+               quarantine: bool = True):
     """``dataset[i]`` under an item-seed context: stochastic transforms
     using ``ThreadLocalRng`` draw from a stream determined by ``token``
-    alone, so augmentations are reproducible across worker counts."""
-    set_item_seed(token)
-    try:
-        return dataset[int(i)]
-    finally:
-        set_item_seed(None)
+    alone, so augmentations are reproducible across worker counts.
+
+    Item loading (decode + augment) retries ``retries`` times on any
+    exception — each attempt re-enters the same seed context, so a retry
+    that succeeds is bit-identical to a first-try success.  An item that
+    keeps failing is *quarantined*: logged and skipped, because one
+    undecodable image must not kill an epoch that is hours into a
+    preemptible run.  ``quarantine=False`` restores fail-fast semantics
+    (the last exception propagates) for callers that prefer to die loudly.
+    """
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        set_item_seed(token)
+        try:
+            return dataset[int(i)]
+        except Exception as e:
+            last = e
+            if attempt < retries:
+                log.warning(
+                    "item %d failed (%s: %s); retry %d/%d",
+                    i, type(e).__name__, e, attempt + 1, retries,
+                )
+        finally:
+            set_item_seed(None)
+    if not quarantine:
+        raise last
+    log.warning(
+        "quarantined item %d after %d attempts (%s: %s)",
+        i, retries + 1, type(last).__name__, last,
+    )
+    return QUARANTINED
 
 
 def _stack(parts):
@@ -40,7 +78,9 @@ def _stack(parts):
     return np.stack(parts)
 
 
-def _pooled_items(dataset, indices, num_workers: int, token_of) -> Iterator:
+def _pooled_items(dataset, indices, num_workers: int, token_of,
+                  retries: int = ITEM_RETRIES,
+                  quarantine: bool = True) -> Iterator:
     """Map ``dataset[i]`` over ``indices`` on a thread pool, in order.
 
     The TPU-native stand-in for DataLoader worker *processes*: PIL decode,
@@ -59,13 +99,13 @@ def _pooled_items(dataset, indices, num_workers: int, token_of) -> Iterator:
     try:
         pending: "collections.deque" = collections.deque()
         for i in it:
-            pending.append(ex.submit(_load_item, dataset, i, token_of(i)))
+            pending.append(ex.submit(_load_item, dataset, i, token_of(i), retries, quarantine))
             if len(pending) >= window:
                 break
         while pending:
             item = pending.popleft().result()
             for i in it:  # top the window back up
-                pending.append(ex.submit(_load_item, dataset, i, token_of(i)))
+                pending.append(ex.submit(_load_item, dataset, i, token_of(i), retries, quarantine))
                 break
             yield item
     finally:
@@ -81,6 +121,8 @@ def batch_iterator(
     epoch: int = 0,
     shard: Optional[Tuple[int, int]] = None,
     num_workers: int = 0,
+    item_retries: int = ITEM_RETRIES,
+    quarantine: bool = True,
 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield tuples of stacked numpy batches from an indexable dataset.
 
@@ -98,7 +140,16 @@ def batch_iterator(
       DataLoader knob (``resnet50…py:558-574``).  Stochastic transforms
       built on ``transforms.ThreadLocalRng`` draw from per-item seeded
       streams (``(seed, epoch, sample_index)``), so a fixed-seed run is
-      bit-reproducible at ANY worker count, pooled or sequential.
+      bit-reproducible at ANY worker count, pooled or sequential;
+    * ``item_retries``/``quarantine``: a failing item load is retried,
+      then (by default) logged and skipped rather than killing the epoch
+      — a quarantined item shifts later batch boundaries by one sample,
+      and the resulting short tail obeys ``drop_last`` as usual.  Under
+      ``shard`` the bad item is instead REPLACED by a duplicate of the
+      nearest good item: dropping it would shorten only this process's
+      epoch and desync the per-process batch counts the sharding
+      invariant above exists to protect.  Pass ``quarantine=False`` to
+      re-raise after the retries instead.
     """
     n = len(dataset)
     order = np.arange(n)
@@ -114,9 +165,14 @@ def batch_iterator(
     indices = order[:stop]
     token_of = lambda i: (seed, epoch, int(i))
     if num_workers and num_workers > 1:
-        items_iter = _pooled_items(dataset, indices, num_workers, token_of)
+        items_iter = _pooled_items(
+            dataset, indices, num_workers, token_of, item_retries, quarantine
+        )
     else:
-        items_iter = (_load_item(dataset, i, token_of(i)) for i in indices)
+        items_iter = (
+            _load_item(dataset, i, token_of(i), item_retries, quarantine)
+            for i in indices
+        )
 
     def _emit(batch):
         return tuple(
@@ -124,12 +180,33 @@ def batch_iterator(
         )
 
     batch = []
+    last_good = None
+    deficit = 0  # quarantined items seen before the first good one (sharded)
     for item in items_iter:
+        if item is QUARANTINED:
+            if shard is None:
+                continue
+            # Sharded: substitute instead of dropping (see docstring).
+            if last_good is None:
+                deficit += 1
+                continue
+            item = last_good
+        else:
+            if deficit:
+                # Repay leading quarantined slots now that a good item
+                # exists, keeping this shard's item count exact.
+                for _ in range(deficit):
+                    batch.append(item)
+                    if len(batch) == batch_size:
+                        yield _emit(batch)
+                        batch = []
+                deficit = 0
+            last_good = item
         batch.append(item)
         if len(batch) == batch_size:
             yield _emit(batch)
             batch = []
-    if batch:  # trailing partial batch, drop_last=False only
+    if batch and not drop_last:  # trailing partial batch
         yield _emit(batch)
 
 
@@ -212,3 +289,10 @@ def prefetch_to_device(
             yield item
     finally:
         stop.set()  # unblocks the producer; queued batches become garbage
+        # close() must not return while the producer is still executing
+        # inside ``iterator``: rollback/preemption teardown closes the
+        # underlying epoch generators right after, which would race with
+        # a live producer ("generator already executing").  The producer
+        # always exits promptly — _put polls ``stop`` every 0.1s and a
+        # single next()/transfer is bounded work.
+        thread.join()

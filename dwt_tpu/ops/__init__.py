@@ -17,6 +17,7 @@ from dwt_tpu.ops.batch_norm import (  # noqa: F401
     batch_norm,
 )
 from dwt_tpu.ops.losses import (  # noqa: F401
+    at_least_f32,
     entropy_loss,
     mec_loss,
     nll_loss,

@@ -25,7 +25,7 @@ for data parallelism (SURVEY §5 distributed backend note).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -98,15 +98,19 @@ def batch_norm(
         else:
             factor = jnp.asarray(momentum, xf.dtype)
         # Unbiased variance feeds the EMA (torch F.batch_norm convention).
+        # The EMA computes in xf's (promoted) precision but is cast back to
+        # the stored stats dtype: f64 activations must not flip the stats
+        # pytree to f64 mid-training — a dtype change recompiles jit and
+        # breaks the lax.scan carry of make_scanned_step under x64.
         unbiased = var * (n / max(n - 1, 1))
         new_stats = BatchNormStats(
             mean=(
                 factor * lax.stop_gradient(m) + (1.0 - factor) * stats.mean
-            ),
+            ).astype(stats.mean.dtype),
             var=(
                 factor * lax.stop_gradient(unbiased)
                 + (1.0 - factor) * stats.var
-            ),
+            ).astype(stats.var.dtype),
             count=count,
         )
         return y.astype(x.dtype), new_stats

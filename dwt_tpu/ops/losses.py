@@ -11,7 +11,13 @@ import jax
 import jax.numpy as jnp
 
 
-def _at_least_f32(x: jax.Array) -> jax.Array:
+def at_least_f32(x: jax.Array) -> jax.Array:
+    """Promote sub-f32 inputs (bf16/f16) to f32; f64 passes through.
+
+    The shared promotion policy for every loss/metric reduction: bf16
+    activations must not accumulate in half precision, and f64 (the
+    lockstep trajectory-parity tests) must not be silently truncated.
+    """
     return x.astype(jnp.promote_types(x.dtype, jnp.float32))
 
 
@@ -21,7 +27,7 @@ def entropy_loss(logits: jax.Array) -> jax.Array:
     ``-mean_n sum_k p_nk log p_nk`` — the target-entropy-minimization term of
     the digits experiment (reference ``usps_mnist.py:183-194``).
     """
-    logits = _at_least_f32(logits)
+    logits = at_least_f32(logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
     p = jnp.exp(logp)
     return -jnp.mean(jnp.sum(p * logp, axis=-1))
@@ -33,8 +39,8 @@ def mec_loss(logits_a: jax.Array, logits_b: jax.Array) -> jax.Array:
     Per sample: ``min_k 0.5 * (-log p_a(k) - log p_b(k))``, then batch mean
     (reference ``utils/consensus_loss.py:11-24``).
     """
-    la = jax.nn.log_softmax(_at_least_f32(logits_a), axis=-1)
-    lb = jax.nn.log_softmax(_at_least_f32(logits_b), axis=-1)
+    la = jax.nn.log_softmax(at_least_f32(logits_a), axis=-1)
+    lb = jax.nn.log_softmax(at_least_f32(logits_b), axis=-1)
     per_class = 0.5 * (-la - lb)  # [N, K]
     return jnp.mean(jnp.min(per_class, axis=-1))
 
@@ -44,7 +50,7 @@ def nll_loss(
 ) -> jax.Array:
     """Negative log likelihood of integer ``labels`` under ``log_probs``."""
     picked = jnp.take_along_axis(
-        _at_least_f32(log_probs), labels[:, None], axis=-1
+        at_least_f32(log_probs), labels[:, None], axis=-1
     )[:, 0]
     if reduction == "mean":
         return -jnp.mean(picked)
@@ -59,7 +65,7 @@ def softmax_cross_entropy(
     """``nll(log_softmax(logits), labels)`` — the reference's cls loss
     (``usps_mnist.py:298``, ``resnet50_dwt_mec_officehome.py:425``)."""
     return nll_loss(
-        jax.nn.log_softmax(_at_least_f32(logits), axis=-1),
+        jax.nn.log_softmax(at_least_f32(logits), axis=-1),
         labels,
         reduction,
     )

@@ -1,0 +1,36 @@
+"""dwt_tpu.resilience — keep long preemptible runs alive and honest.
+
+Production TPU training dies three ways the reference code never had to
+survive: the scheduler preempts the VM (SIGTERM, short grace window), the
+numerics diverge (a Cholesky NaN poisons every later step), and I/O fails
+half-way (torn checkpoints, undecodable dataset items).  This package
+provides the three corresponding defenses, plus deterministic fault
+injection (:mod:`~dwt_tpu.resilience.inject`) so every recovery path is
+provable in CI on CPU:
+
+* :class:`PreemptionHandler` — flag-only signal handler polled at step
+  boundaries; final checkpoint + clean exit 0 on SIGTERM/SIGINT.
+* :class:`DivergenceGuard` — amortized jitted finite-checks with
+  ``halt`` / ``skip_step`` / ``rollback`` recovery policies.
+* atomic validated checkpoints live in :mod:`dwt_tpu.utils.checkpoint`
+  (write-to-tmp + rename, per-step manifest, newest-valid fallback);
+  retry/quarantine item loading lives in :mod:`dwt_tpu.data.loader`.
+"""
+
+from dwt_tpu.resilience import inject
+from dwt_tpu.resilience.guard import (
+    POLICIES,
+    DivergenceError,
+    DivergenceGuard,
+    RollbackRequest,
+)
+from dwt_tpu.resilience.preemption import PreemptionHandler
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceGuard",
+    "POLICIES",
+    "PreemptionHandler",
+    "RollbackRequest",
+    "inject",
+]

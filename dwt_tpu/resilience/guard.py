@@ -1,0 +1,160 @@
+"""Divergence guard: amortized finite-checks with a recovery policy.
+
+The DWT forward path runs a Cholesky factorization per whitening site per
+step; ill-conditioned batch covariances can (rarely) produce a NaN/Inf
+that silently poisons every later step — on a preemptible multi-day run
+the job keeps burning TPU hours training garbage.  Guarding every step
+with a host-side ``isfinite`` would serialize the async dispatch queue,
+so the guard checks every ``interval`` steps: it keeps device references
+to the latest loss/grad-norm metrics (free — no sync) and only fetches a
+single jitted boolean verdict at check boundaries.  NaN is absorbing
+(poisoned params keep producing NaN losses), so an amortized check still
+catches any divergence, at most ``interval - 1`` steps late.
+
+Policies on detection:
+
+* ``halt`` — raise :class:`DivergenceError`; the scheduler/operator sees
+  a failed job instead of a silently-ruined one.
+* ``skip_step`` — revert to the in-memory snapshot taken at the last
+  passing check and continue with fresh batches (drops at most
+  ``interval`` steps of progress; no disk I/O).
+* ``rollback`` — raise :class:`RollbackRequest`; the training loop
+  restores the newest *valid* on-disk checkpoint and re-seeds its data
+  streams so the replayed segment draws a different batch order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+POLICIES = ("none", "halt", "skip_step", "rollback")
+
+
+class DivergenceError(RuntimeError):
+    """Non-finite loss/grad detected and the policy says stop."""
+
+
+class RollbackRequest(Exception):
+    """Control-flow signal: restore the last valid checkpoint and retry.
+
+    Raised by :class:`DivergenceGuard`, caught by the training loops'
+    rollback wrapper — never escapes a loop.
+    """
+
+    def __init__(self, step: int, reason: str):
+        super().__init__(reason)
+        self.step = step
+        self.reason = reason
+
+
+def _snapshot(state: Any) -> Any:
+    """Device-side deep copy of the train state.
+
+    A plain reference is NOT enough: the ``steps_per_dispatch`` paths
+    donate the input state's buffers to the compiled step, so a kept
+    reference would be invalidated by the very next dispatch.  Fresh
+    buffers survive donation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(jnp.copy, state)
+
+
+class DivergenceGuard:
+    def __init__(
+        self,
+        policy: str,
+        interval: int,
+        logger=None,
+        max_rollbacks: int = 3,
+    ):
+        if policy not in POLICIES or policy == "none":
+            raise ValueError(
+                f"guard policy must be one of {POLICIES[1:]}; got {policy!r}"
+            )
+        self.policy = policy
+        self.interval = max(1, int(interval))
+        self.max_rollbacks = max_rollbacks
+        self.rollbacks = 0
+        self._logger = logger
+        self._since_check = 0
+        self._good: Optional[Any] = None
+        self._verdict_fn = None
+
+    # ------------------------------------------------------------- internals
+
+    def _finite(self, metrics) -> bool:
+        """One host sync: jitted all-finite verdict over loss + grad norm.
+
+        Accepts scalar metrics (per-step path) or ``[k]``-stacked metrics
+        (chunked path) — ``all`` reduces either.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._verdict_fn is None:
+            self._verdict_fn = jax.jit(
+                lambda loss, gn: jnp.all(jnp.isfinite(loss))
+                & jnp.all(jnp.isfinite(gn))
+            )
+        loss = metrics["loss"]
+        gn = metrics.get("grad_norm", loss)
+        return bool(self._verdict_fn(loss, gn))
+
+    def _log(self, kind: str, step: int, **values) -> None:
+        if self._logger is not None:
+            self._logger.log(kind, step, sync=True, **values)
+
+    # ------------------------------------------------------------------ API
+
+    def prime(self, state: Any) -> None:
+        """Record the initial known-good state (pre-training or post-resume),
+        so a divergence before the first passing check is still recoverable."""
+        if self.policy in ("skip_step", "rollback"):
+            self._good = _snapshot(state)
+
+    @property
+    def good_state(self) -> Optional[Any]:
+        """A fresh copy of the last known-good state (donation-safe)."""
+        if self._good is None:
+            return None
+        return _snapshot(self._good)
+
+    def step(self, state: Any, metrics: Any, n_steps: int, step_no: int) -> Any:
+        """Account ``n_steps`` finished steps whose latest metrics are
+        ``metrics``; run the amortized check when due.  Returns the state
+        to continue from (replaced under ``skip_step`` recovery).
+
+        ``metrics`` may hold device arrays — they are only fetched at
+        check boundaries, so the async dispatch pipeline stays full
+        between checks.
+        """
+        self._since_check += n_steps
+        if self._since_check < self.interval:
+            return state
+        self._since_check = 0
+        if self._finite(metrics):
+            if self.policy in ("skip_step", "rollback"):
+                self._good = _snapshot(state)
+            return state
+        return self._diverged(state, step_no)
+
+    def _diverged(self, state: Any, step_no: int) -> Any:
+        self._log("divergence", step_no, policy=self.policy)
+        if self.policy == "skip_step" and self._good is not None:
+            self._log("skip_step", step_no)
+            return self.good_state
+        if self.policy == "rollback":
+            if self.rollbacks >= self.max_rollbacks:
+                raise DivergenceError(
+                    f"non-finite loss/grad at step {step_no}; "
+                    f"{self.rollbacks} rollbacks already spent — halting"
+                )
+            self.rollbacks += 1
+            raise RollbackRequest(
+                step_no, f"non-finite loss/grad at step {step_no}"
+            )
+        raise DivergenceError(
+            f"non-finite loss/grad at step {step_no} (policy={self.policy})"
+        )

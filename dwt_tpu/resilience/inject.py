@@ -1,0 +1,163 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Production training must survive four failure classes that are impossible
+to reproduce on demand with real hardware: numeric divergence (a NaN loss
+at some step), a preemption/crash landing *inside* a checkpoint save, a
+checkpoint truncated by a dead filesystem, and a corrupt/undecodable
+dataset item.  This module provides deterministic stand-ins for each,
+consulted by the production code at exactly the points the real fault
+would strike:
+
+* ``maybe_nan(state, metrics, lo, hi)`` — called by the train loops after
+  each dispatch; poisons params + metrics with NaN once, when the armed
+  step falls in ``[lo, hi]`` (the divergence-guard recovery paths).
+* ``maybe_crash_mid_save(step)`` — called by ``save_state`` after the
+  checkpoint bytes are written but *before* the atomic finalize rename;
+  raises :class:`SimulatedCrash`, leaving an unfinalized tmp directory
+  behind exactly like a SIGKILL mid-save (the restore-fallback path).
+* :class:`FlakyDataset` — wraps any dataset so chosen indices raise for
+  the first N accesses (transient I/O) or always (corrupt item), driving
+  the loader's retry/quarantine path.
+
+All hooks are no-ops (one ``is None`` check) unless a plan is armed, so
+the production hot paths pay nothing.  Arm programmatically with
+:func:`arm`, or via the ``DWT_FAULT_PLAN`` env var (JSON, read once at
+first use) for subprocess tests.  Every fault fires at most once per arm:
+recovery paths must not re-trip on the state they just repaired.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+ENV_VAR = "DWT_FAULT_PLAN"
+
+
+class SimulatedCrash(Exception):
+    """Raised by an armed kill-mid-save hook (stands in for SIGKILL)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One-shot fault schedule.  Fields default to "never fire"."""
+
+    # Poison params/metrics with NaN after the train step with this
+    # (1-based) global step number completes.
+    nan_at_step: Optional[int] = None
+    # Raise SimulatedCrash inside save_state after the bytes are written
+    # but before the finalize rename.  True = next save; int = the save
+    # at that step.
+    crash_in_save: Any = None
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get(ENV_VAR)
+        if not raw:
+            return None
+        spec = json.loads(raw)
+        return cls(
+            nan_at_step=spec.get("nan_at_step"),
+            crash_in_save=spec.get("crash_in_save"),
+        )
+
+
+_plan: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def arm(plan: FaultPlan) -> None:
+    global _plan, _env_checked
+    _plan = plan
+    _env_checked = True
+
+
+def disarm() -> None:
+    global _plan, _env_checked
+    _plan = None
+    # Re-reading the env on the next current() would re-arm a consumed
+    # subprocess plan — mark it checked so disarm is final in-process.
+    _env_checked = True
+
+
+def current() -> Optional[FaultPlan]:
+    """The armed plan, lazily picking up ``DWT_FAULT_PLAN`` once."""
+    global _plan, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        _plan = FaultPlan.from_env()
+    return _plan
+
+
+def _poison_tree(tree: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    def nan_like(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x * jnp.asarray(jnp.nan, x.dtype)
+        return x
+
+    return jax.tree.map(nan_like, tree)
+
+
+def maybe_nan(state, metrics, lo: int, hi: Optional[int] = None) -> Tuple[Any, Any]:
+    """Poison ``(state.params, metrics)`` with NaN if the armed step is in
+    ``[lo, hi]`` (both inclusive; ``hi`` defaults to ``lo``).  Fires once.
+
+    The chunked (``steps_per_dispatch``) path passes the whole dispatched
+    step range, since the host only regains control at chunk boundaries —
+    the same granularity at which a real mid-chunk NaN becomes observable.
+    """
+    plan = current()
+    if plan is None or plan.nan_at_step is None:
+        return state, metrics
+    hi = lo if hi is None else hi
+    if not (lo <= plan.nan_at_step <= hi):
+        return state, metrics
+    plan.nan_at_step = None  # one-shot
+    state = state.replace(params=_poison_tree(state.params))
+    return state, _poison_tree(dict(metrics))
+
+
+def maybe_crash_mid_save(step: int) -> None:
+    """Raise :class:`SimulatedCrash` if armed for this save.  Fires once."""
+    plan = current()
+    if plan is None or plan.crash_in_save is None:
+        return
+    if plan.crash_in_save is True or int(plan.crash_in_save) == int(step):
+        plan.crash_in_save = None  # one-shot
+        raise SimulatedCrash(f"injected crash during checkpoint save @{step}")
+
+
+class FlakyDataset:
+    """Dataset wrapper whose chosen indices raise on access.
+
+    ``fail={idx: n}`` — index ``idx`` raises :class:`OSError` for its
+    first ``n`` accesses, then succeeds (transient I/O; exercises retry).
+    ``corrupt=(idx, ...)`` — those indices always raise (undecodable item;
+    exercises quarantine).  Deterministic: failures depend only on the
+    access count per index.
+    """
+
+    def __init__(self, base, fail: Optional[Dict[int, int]] = None,
+                 corrupt: Tuple[int, ...] = ()):
+        self.base = base
+        self.fail = dict(fail or {})
+        self.corrupt = frozenset(corrupt)
+        self._counts: Dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, i: int):
+        i = int(i)
+        if i in self.corrupt:
+            raise OSError(f"injected corrupt item {i}")
+        seen = self._counts.get(i, 0)
+        self._counts[i] = seen + 1
+        if seen < self.fail.get(i, 0):
+            raise OSError(f"injected transient failure {i} (attempt {seen + 1})")
+        return self.base[i]

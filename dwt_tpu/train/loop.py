@@ -45,6 +45,13 @@ from dwt_tpu.data import (
     random_affine,
 )
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
+from dwt_tpu.resilience import (
+    DivergenceError,
+    DivergenceGuard,
+    PreemptionHandler,
+    RollbackRequest,
+    inject,
+)
 from dwt_tpu.train.optim import adam_l2, multistep_schedule, officehome_tx
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
@@ -267,14 +274,22 @@ def _run_chunks(state, chunks, raw_step, make_chunked, fns, on_steps):
     compiling one scanned step per distinct chunk length (cached in
     ``fns``, which the caller owns so the cache survives epochs), then
     hand ``(state, n, stacked_metrics)`` to ``on_steps`` for per-inner-
-    step logging and boundary actions.  Shared by both training loops."""
+    step logging and boundary actions.  Shared by both training loops.
+
+    ``on_steps`` may return ``(state, stop)`` to substitute the state the
+    next chunk continues from (divergence-guard ``skip_step`` recovery /
+    fault injection) and to request a clean early exit (preemption)."""
     for chunk in chunks:
         n = _chunk_len(chunk)
         fn = fns.get(n)
         if fn is None:
             fn = fns[n] = make_chunked(raw_step, n)
         state, ms = fn(state, chunk)
-        on_steps(state, n, ms)
+        out = on_steps(state, n, ms)
+        if out is not None:
+            state, stop = out
+            if stop:
+                break
     return state
 
 
@@ -288,6 +303,53 @@ def _params_digest(state: TrainState) -> float:
         arr = np.asarray(jax.device_get(leaf.addressable_data(0)), np.float64)
         total += float(np.abs(arr).sum())
     return total
+
+
+def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
+    policy = getattr(cfg, "guard_policy", "none") or "none"
+    if policy == "none":
+        return None
+    return DivergenceGuard(
+        policy,
+        getattr(cfg, "guard_interval", 50),
+        logger,
+        max_rollbacks=getattr(cfg, "guard_max_rollbacks", 3),
+    )
+
+
+# Seed stride between rollback attempts: a prime far from any plausible
+# user seed spacing, so the re-seeded shuffle streams of attempt k never
+# collide with attempt k-1's (replaying the exact batch order that just
+# diverged would be the one guaranteed-useless retry).
+_ROLLBACK_SEED_STRIDE = 7919
+
+
+def _rollback_state(cfg, logger, guard: DivergenceGuard, template, failed_step):
+    """Recovery state for a ``rollback`` policy hit: the newest valid
+    on-disk checkpoint, else the guard's last in-memory good state."""
+    restored, source = None, "checkpoint"
+    if cfg.ckpt_dir:
+        try:
+            restored = restore_state(cfg.ckpt_dir, template)
+        except FileNotFoundError:
+            restored = None
+    if restored is None:
+        restored, source = guard.good_state, "memory"
+    if restored is None:
+        raise DivergenceError(
+            f"divergence at step {failed_step} with nothing to roll back "
+            "to (no valid checkpoint, no in-memory snapshot)"
+        )
+    guard.prime(restored)  # next divergence measures from THIS state
+    logger.log(
+        "rollback",
+        int(restored.step),
+        from_step=failed_step,
+        source=source,
+        rollbacks=guard.rollbacks,
+        sync=True,
+    )
+    return restored
 
 
 def _best_record_path(ckpt_dir: str) -> str:
@@ -475,87 +537,136 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         )
         return result["accuracy"]
 
+    guard = _make_guard(cfg, logger)
+    if guard:
+        guard.prime(state)
     acc = 0.0
-    for epoch in range(start_epoch, cfg.epochs):
-        source_iter = batch_iterator(
-            source_ds, local_bs, shuffle=True, seed=cfg.seed, epoch=epoch,
-            shard=shard, num_workers=cfg.num_workers,
-        )
-        target_iter = batch_iterator(
-            target_ds, local_bs, shuffle=True, seed=cfg.seed + 1, epoch=epoch,
-            shard=shard, num_workers=cfg.num_workers,
-        )
-
-        def epoch_batches():
-            for (sx, sy), (txi, _) in zip(source_iter, target_iter):
-                yield {
-                    "source_x": np.asarray(sx, np.float32),
-                    "source_y": np.asarray(sy),
-                    "target_x": np.asarray(txi, np.float32),
-                }
-
-        # Host-side batch assembly overlaps device compute: the prefetch
-        # thread stages (and places) the next batches while the step runs;
-        # item decode/augment parallelism lives in batch_iterator's pool.
-        if k_dispatch == 1:
-            batches = prefetch_to_device(
-                epoch_batches(), size=2, transfer=wrap_batch
+    epoch = start_epoch
+    seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
+    gstep = int(state.step)  # host-side global step count (guard/injection)
+    with PreemptionHandler(logger) as preempt:
+        while epoch < cfg.epochs:
+            source_iter = batch_iterator(
+                source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
+                epoch=epoch, shard=shard, num_workers=cfg.num_workers,
             )
-            for i, batch in enumerate(batches):
-                state, metrics = train_step(state, batch)
-                if i % cfg.log_interval == 0:
-                    logger.log(
-                        "train",
-                        int(state.step),
-                        epoch=epoch,
-                        cls_loss=metrics["cls_loss"],
-                        entropy_loss=metrics["entropy_loss"],
+            target_iter = batch_iterator(
+                target_ds, local_bs, shuffle=True,
+                seed=cfg.seed + 1 + seed_bump, epoch=epoch, shard=shard,
+                num_workers=cfg.num_workers,
+            )
+
+            def epoch_batches():
+                for (sx, sy), (txi, _) in zip(source_iter, target_iter):
+                    yield {
+                        "source_x": np.asarray(sx, np.float32),
+                        "source_y": np.asarray(sy),
+                        "target_x": np.asarray(txi, np.float32),
+                    }
+
+            # Host-side batch assembly overlaps device compute: the prefetch
+            # thread stages (and places) the next batches while the step
+            # runs; item decode/augment parallelism lives in
+            # batch_iterator's pool.
+            batches = None
+            try:
+                if k_dispatch == 1:
+                    batches = prefetch_to_device(
+                        epoch_batches(), size=2, transfer=wrap_batch
                     )
-        else:
-            # k steps per dispatch: scan over stacked batches; metrics
-            # come back [n]-stacked so the log cadence is unchanged.
-            # Step numbers come from a host-side counter — reading
-            # int(st.step) every chunk would sync the host on the whole
-            # chunk and re-open the dispatch gap this path removes.
-            pos = 0
-            step0 = int(state.step)
+                    for i, batch in enumerate(batches):
+                        state, metrics = train_step(state, batch)
+                        gstep += 1
+                        state, metrics = inject.maybe_nan(state, metrics, gstep)
+                        if i % cfg.log_interval == 0:
+                            logger.log(
+                                "train",
+                                int(state.step),
+                                epoch=epoch,
+                                cls_loss=metrics["cls_loss"],
+                                entropy_loss=metrics["entropy_loss"],
+                            )
+                        if guard:
+                            state = guard.step(state, metrics, 1, gstep)
+                        if preempt.should_stop:
+                            break
+                else:
+                    # k steps per dispatch: scan over stacked batches;
+                    # metrics come back [n]-stacked so the log cadence is
+                    # unchanged.  Step numbers come from a host-side
+                    # counter — reading int(st.step) every chunk would
+                    # sync the host on the whole chunk and re-open the
+                    # dispatch gap this path removes.  Guard/preemption
+                    # run at chunk boundaries — the host's only
+                    # consistency points on this path.
+                    pos = 0
+                    step0 = int(state.step)
 
-            def on_steps(st, n, ms):
-                nonlocal pos
-                for j in range(pos, pos + n):
-                    if j % cfg.log_interval == 0:
-                        jj = j - pos
-                        logger.log(
-                            "train",
-                            step0 + j + 1,
-                            epoch=epoch,
-                            cls_loss=ms["cls_loss"][jj],
-                            entropy_loss=ms["entropy_loss"][jj],
-                        )
-                pos += n
+                    def on_steps(st, n, ms):
+                        nonlocal pos, gstep
+                        lo = gstep + 1
+                        gstep += n
+                        st, ms = inject.maybe_nan(st, ms, lo, gstep)
+                        for j in range(pos, pos + n):
+                            if j % cfg.log_interval == 0:
+                                jj = j - pos
+                                logger.log(
+                                    "train",
+                                    step0 + j + 1,
+                                    epoch=epoch,
+                                    cls_loss=ms["cls_loss"][jj],
+                                    entropy_loss=ms["entropy_loss"][jj],
+                                )
+                        pos += n
+                        if guard:
+                            st = guard.step(st, ms, n, gstep)
+                        return st, preempt.should_stop
 
-            state = _run_chunks(
-                state,
-                prefetch_to_device(
-                    _chunk_stream(epoch_batches(), k_dispatch),
-                    size=2,
-                    transfer=wrap_chunk,
-                ),
-                raw_step,
-                make_chunked,
-                chunk_fns,
-                on_steps,
+                    batches = prefetch_to_device(
+                        _chunk_stream(epoch_batches(), k_dispatch),
+                        size=2,
+                        transfer=wrap_chunk,
+                    )
+                    state = _run_chunks(
+                        state, batches, raw_step, make_chunked, chunk_fns,
+                        on_steps,
+                    )
+            except RollbackRequest as rb:
+                state = _rollback_state(cfg, logger, guard, state, rb.step)
+                gstep = int(state.step)
+                epoch = gstep // steps_per_epoch
+                seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                continue
+            finally:
+                # Tear the pipeline down on EVERY exit (normal epoch end,
+                # rollback, preemption break, error): the prefetch close
+                # joins its producer thread, making the epoch-iterator
+                # closes safe, and releases staged device batches + the
+                # decode worker pools before the next attempt builds fresh
+                # ones.
+                if batches is not None:
+                    batches.close()
+                source_iter.close()
+                target_iter.close()
+            if preempt.should_stop:
+                # Preemption grace windows are short: save and get out —
+                # skip the per-epoch eval, return with exit code 0.
+                if cfg.ckpt_dir:
+                    save_state(cfg.ckpt_dir, int(state.step), state)
+                logger.log("preempt", int(state.step), epoch=epoch, sync=True)
+                return acc
+            result = _evaluate(
+                eval_step, state, target_test_ds, cfg.test_batch_size,
+                num_workers=cfg.num_workers,
             )
-        result = _evaluate(
-            eval_step, state, target_test_ds, cfg.test_batch_size,
-            num_workers=cfg.num_workers,
-        )
-        acc = result["accuracy"]
-        logger.log("test", int(state.step), epoch=epoch, **result)
-        if cfg.ckpt_dir and (
-            (epoch + 1) % cfg.ckpt_every_epochs == 0 or epoch == cfg.epochs - 1
-        ):
-            save_state(cfg.ckpt_dir, int(state.step), state)
+            acc = result["accuracy"]
+            logger.log("test", int(state.step), epoch=epoch, **result)
+            if cfg.ckpt_dir and (
+                (epoch + 1) % cfg.ckpt_every_epochs == 0
+                or epoch == cfg.epochs - 1
+            ):
+                save_state(cfg.ckpt_dir, int(state.step), state)
+            epoch += 1
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
     return acc
 
@@ -710,30 +821,6 @@ def run_officehome(
     eval_step = jax.jit(make_eval_step(eval_model))
     collect_step = jax.jit(make_stat_collection_step(eval_model, num_domains=3))
 
-    source_stream = infinite(
-        lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
-                                 seed=cfg.seed, epoch=e, shard=shard,
-                                 num_workers=cfg.num_workers)
-    )
-    target_stream = infinite(
-        lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
-                                 seed=cfg.seed + 1, epoch=e, shard=shard,
-                                 num_workers=cfg.num_workers)
-    )
-
-    def train_batches():
-        # Finite (num_iters - start_iter) stream so the prefetch producer
-        # thread terminates with the loop.
-        for _ in range(start_iter, cfg.num_iters):
-            sx, sy = next(source_stream)
-            tx_img, tx_aug, _ = next(target_stream)
-            yield {
-                "source_x": np.asarray(sx, np.float32),
-                "source_y": np.asarray(sy),
-                "target_x": np.asarray(tx_img, np.float32),
-                "target_aug_x": np.asarray(tx_aug, np.float32),
-            }
-
     acc = 0.0
 
     def _log_train(it, step_no, cls, mec):
@@ -773,66 +860,137 @@ def run_officehome(
     # pipeline is the expensive host stage for OfficeHome); the per-item
     # decode/augment parallelism lives in batch_iterator's worker pool.
     k_dispatch = max(1, cfg.steps_per_dispatch)
-    # Host-side step numbering for train logs: int(state.step) inside the
-    # hot loop would block on the just-dispatched step every iteration,
-    # destroying async-dispatch pipelining; the count is fully determined
-    # host-side as step0 + iter + 1.
-    step0 = int(state.step) - start_iter
-    if k_dispatch == 1:
-        batches = prefetch_to_device(
-            train_batches(), size=2, transfer=wrap_batch
-        )
-        for it, batch in enumerate(batches, start=start_iter):
-            state, metrics = train_step(state, batch)
-            if it % cfg.log_interval == 0:
-                _log_train(
-                    it, step0 + it + 1,
-                    metrics["cls_loss"], metrics["mec_loss"],
-                )
-            _boundary_actions(it)
-    else:
-        # Checkpoint boundaries only matter when checkpointing is on —
-        # cutting at them anyway would compile an extra odd-length
-        # scanned program for a save that never happens.
-        should_cut = lambda i: (
-            (i + 1) % cfg.check_acc_step == 0
-            or (cfg.ckpt_dir and (i + 1) % cfg.ckpt_every_iters == 0)
-        )
-        it = start_iter
+    guard = _make_guard(cfg, logger)
+    if guard:
+        guard.prime(state)
+    seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
+    with PreemptionHandler(logger) as preempt:
+        # Rollback retry loop: each attempt builds fresh (re-seeded)
+        # streams and trains from the current state; a RollbackRequest
+        # restores the newest valid checkpoint and starts a new attempt.
+        while True:
+            source_stream = infinite(
+                lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
+                                         seed=cfg.seed + seed_bump, epoch=e,
+                                         shard=shard,
+                                         num_workers=cfg.num_workers)
+            )
+            target_stream = infinite(
+                lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
+                                         seed=cfg.seed + 1 + seed_bump,
+                                         epoch=e, shard=shard,
+                                         num_workers=cfg.num_workers)
+            )
 
-        def on_steps(st, n, ms):
-            nonlocal it, state
-            state = st  # _boundary_actions evaluates/saves the live state
-            for j in range(n):
-                if (it + j) % cfg.log_interval == 0:
-                    _log_train(
-                        it + j,
-                        step0 + it + j + 1,
-                        ms["cls_loss"][j],
-                        ms["mec_loss"][j],
+            def train_batches():
+                # Finite (num_iters - start_iter) stream so the prefetch
+                # producer thread terminates with the loop.
+                for _ in range(start_iter, cfg.num_iters):
+                    sx, sy = next(source_stream)
+                    tx_img, tx_aug, _ = next(target_stream)
+                    yield {
+                        "source_x": np.asarray(sx, np.float32),
+                        "source_y": np.asarray(sy),
+                        "target_x": np.asarray(tx_img, np.float32),
+                        "target_aug_x": np.asarray(tx_aug, np.float32),
+                    }
+
+            # Host-side step numbering for train logs: int(state.step)
+            # inside the hot loop would block on the just-dispatched step
+            # every iteration, destroying async-dispatch pipelining; the
+            # count is fully determined host-side as step0 + iter + 1.
+            step0 = int(state.step) - start_iter
+            batches = None
+            try:
+                if k_dispatch == 1:
+                    batches = prefetch_to_device(
+                        train_batches(), size=2, transfer=wrap_batch
                     )
-            it += n
-            _boundary_actions(it - 1)
+                    for it, batch in enumerate(batches, start=start_iter):
+                        state, metrics = train_step(state, batch)
+                        state, metrics = inject.maybe_nan(
+                            state, metrics, step0 + it + 1
+                        )
+                        if it % cfg.log_interval == 0:
+                            _log_train(
+                                it, step0 + it + 1,
+                                metrics["cls_loss"], metrics["mec_loss"],
+                            )
+                        if guard:
+                            state = guard.step(
+                                state, metrics, 1, step0 + it + 1
+                            )
+                        _boundary_actions(it)
+                        if preempt.should_stop:
+                            break
+                else:
+                    # Checkpoint boundaries only matter when checkpointing
+                    # is on — cutting at them anyway would compile an extra
+                    # odd-length scanned program for a save that never
+                    # happens.  Guard/preemption run at chunk boundaries.
+                    should_cut = lambda i: (
+                        (i + 1) % cfg.check_acc_step == 0
+                        or (cfg.ckpt_dir and (i + 1) % cfg.ckpt_every_iters == 0)
+                    )
+                    it = start_iter
 
-        state = _run_chunks(
-            state,
-            prefetch_to_device(
-                _chunk_stream(
-                    train_batches(), k_dispatch, should_cut, start=start_iter
-                ),
-                size=2,
-                transfer=wrap_chunk,
-            ),
-            raw_step,
-            make_chunked,
-            {},
-            on_steps,
-        )
+                    def on_steps(st, n, ms):
+                        nonlocal it, state
+                        state, ms = inject.maybe_nan(
+                            st, ms, step0 + it + 1, step0 + it + n
+                        )
+                        for j in range(n):
+                            if (it + j) % cfg.log_interval == 0:
+                                _log_train(
+                                    it + j,
+                                    step0 + it + j + 1,
+                                    ms["cls_loss"][j],
+                                    ms["mec_loss"][j],
+                                )
+                        it += n
+                        if guard:
+                            state = guard.step(state, ms, n, step0 + it)
+                        # _boundary_actions evaluates/saves the live state
+                        _boundary_actions(it - 1)
+                        return state, preempt.should_stop
 
-    # Release the abandoned infinite streams' worker pools and in-flight
-    # decoded batches before the stat-collection/eval phase.
-    source_stream.close()
-    target_stream.close()
+                    batches = prefetch_to_device(
+                        _chunk_stream(
+                            train_batches(), k_dispatch, should_cut,
+                            start=start_iter,
+                        ),
+                        size=2,
+                        transfer=wrap_chunk,
+                    )
+                    state = _run_chunks(
+                        state, batches, raw_step, make_chunked, {}, on_steps,
+                    )
+            except RollbackRequest as rb:
+                state = _rollback_state(cfg, logger, guard, state, rb.step)
+                start_iter = int(state.step)
+                seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                continue
+            finally:
+                # Tear the pipeline down on EVERY exit (training done,
+                # rollback retry, preemption break, error) — prefetch
+                # close first (joins its producer thread, making the
+                # stream closes race-free), then the infinite streams,
+                # releasing their worker pools and in-flight decoded
+                # batches before the next attempt / the stat-collection
+                # phase.
+                if batches is not None:
+                    batches.close()
+                source_stream.close()
+                target_stream.close()
+            break
+
+        if preempt.should_stop:
+            # Save and get out inside the grace window; skip the
+            # stat-collection protocol (a resumed run redoes it).
+            if cfg.ckpt_dir:
+                save_state(cfg.ckpt_dir, int(state.step), state)
+            logger.log("preempt", int(state.step), sync=True)
+            return acc
 
     # Post-training protocol: N gradient-free train-mode passes over the
     # target TEST set with tripled data to re-estimate target stats

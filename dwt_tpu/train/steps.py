@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from dwt_tpu.ops.losses import entropy_loss, mec_loss, nll_loss, softmax_cross_entropy
+from dwt_tpu.ops.losses import (
+    at_least_f32,
+    entropy_loss,
+    mec_loss,
+    nll_loss,
+    softmax_cross_entropy,
+)
 from dwt_tpu.ops.whitening import AxisName
 from dwt_tpu.train.state import TrainState
 
@@ -101,6 +107,10 @@ def make_digits_train_step(
         metrics = _pmean_if(
             {"loss": loss, "cls_loss": cls, "entropy_loss": ent}, axis_name
         )
+        # Global grad norm rides along as a device scalar: the divergence
+        # guard's finite-check input (and a free training-health metric) —
+        # grads can go non-finite a step before the loss does.
+        metrics["grad_norm"] = optax.global_norm(grads)
         return _apply_grads(state, tx, grads, stats), metrics
 
     return train_step
@@ -143,6 +153,9 @@ def make_officehome_train_step(
         metrics = _pmean_if(
             {"loss": loss, "cls_loss": cls, "mec_loss": mec}, axis_name
         )
+        # See make_digits_train_step: the divergence guard's finite-check
+        # input, computed on the already-reduced global gradients.
+        metrics["grad_norm"] = optax.global_norm(grads)
         return _apply_grads(state, tx, grads, stats), metrics
 
     return train_step
@@ -208,7 +221,7 @@ def make_eval_step(
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats}, x, train=False
         )
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = jax.nn.log_softmax(at_least_f32(logits), axis=-1)
         loss_sum = nll_loss(logp, y, reduction="sum")
         correct = jnp.sum(
             (jnp.argmax(logits, axis=-1) == y).astype(jnp.int32)

@@ -1,62 +1,332 @@
-"""Orbax checkpoint save/restore for ``TrainState`` (SURVEY §5).
+"""Atomic, validated Orbax checkpointing for ``TrainState`` (SURVEY §5).
 
 The reference never saves anything (checkpoint/resume is read-only there,
-``resnet50…py:367``); preemption resilience on TPU requires periodic saves.
-The whole ``TrainState`` is one pytree, so Orbax handles it directly.
+``resnet50…py:367``); preemption resilience on TPU requires periodic saves
+— and saves that a preemption can land *inside*.  Three defenses:
+
+* **atomic finalize** — Orbax writes into a ``.tmp-…`` sibling; only after
+  the manifest is written is the directory renamed to ``<step>``.  A kill
+  at any point leaves either the previous checkpoints untouched plus a
+  recognizable tmp dir (swept by the next save), never a half-written
+  ``<step>`` that a resume would trust.
+* **per-step manifest** — ``manifest.json`` inside each checkpoint records
+  the step, a SHA-256 digest of the param tree, a wall-clock timestamp,
+  and every file's size.  ``latest_step``/``restore_state`` treat a
+  checkpoint as valid only if the manifest and all recorded sizes check
+  out (detects truncation without reading array bytes), and the digest is
+  re-verified after restore (detects bit corruption).
+* **newest-valid fallback** — restore walks candidates newest → oldest and
+  returns the first that validates AND restores, instead of crashing the
+  resumed job on the artifact the crash itself tore.
+
+Checkpoint I/O additionally retries transient ``OSError`` with bounded
+exponential backoff (flaky NFS/GCS fuse mounts).  Directories without a
+manifest are accepted as legacy artifacts (pre-manifest converter output)
+— finalized-by-rename still guarantees they are complete.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, Callable, List, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+from dwt_tpu.resilience import inject
+
+log = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+_TMP_PREFIX = ".tmp-"
+
+# Transient-I/O retry policy (checkpoint save/restore only; item-level
+# data retries live in dwt_tpu.data.loader).
+IO_RETRIES = 3
+IO_BACKOFF_S = 0.05
 
 
 def _root(ckpt_dir: str) -> str:
     return os.path.abspath(os.path.expanduser(ckpt_dir))
 
 
-def save_state(
-    ckpt_dir: str, step: int, state: Any, keep: Optional[int] = None
-) -> str:
-    """Write ``state`` under ``ckpt_dir/<step>``; returns the path.
+def _with_retries(fn: Callable[[], Any], what: str,
+                  retries: int = IO_RETRIES,
+                  backoff_s: float = IO_BACKOFF_S) -> Any:
+    """Run ``fn`` retrying transient ``OSError`` with bounded backoff."""
+    for attempt in range(retries):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt == retries - 1:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            log.warning(
+                "%s failed (%s); retry %d/%d in %.2fs",
+                what, e, attempt + 1, retries - 1, delay,
+            )
+            time.sleep(delay)
 
-    Overwrites an existing same-step checkpoint (``force=True``) so
-    crash-resume re-saves are idempotent instead of raising.  ``keep=N``
-    prunes to the newest ``N`` steps after saving (``keep=1`` is the
-    reference's single-artifact "model_best" convention).
+
+def params_digest(params: Any) -> str:
+    """SHA-256 over the param tree's leaves (values, shapes, dtypes, and
+    tree paths), host-side.  Order-stable: ``jax.tree`` flattening order."""
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _write_manifest(path: str, step: int, digest: str) -> None:
+    files = {}
+    for sub, _, names in os.walk(path):
+        for name in names:
+            full = os.path.join(sub, name)
+            files[os.path.relpath(full, path)] = os.path.getsize(full)
+    manifest = {
+        "step": int(step),
+        "params_digest": digest,
+        "timestamp": time.time(),
+        "files": files,
+    }
+    with open(os.path.join(path, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def _read_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(path, MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    """A finalized checkpoint whose manifest (if any) checks out.
+
+    Unfinalized tmp dirs are never valid; manifest-less finalized dirs are
+    legacy artifacts and accepted as-is.
     """
-    path = os.path.join(_root(ckpt_dir), str(int(step)))
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
-    if keep is not None:
-        import shutil
+    if not os.path.isdir(path) or os.path.basename(path).startswith(_TMP_PREFIX):
+        return False
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        return True  # legacy (pre-manifest) checkpoint
+    manifest = _read_manifest(path)
+    if manifest is None:
+        return False
+    for rel, size in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full) or os.path.getsize(full) != size:
+            return False
+    return True
 
-        root = _root(ckpt_dir)
-        steps = sorted(int(d) for d in os.listdir(root) if d.isdigit())
-        for old in steps[:-keep]:
-            shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
-    return path
+
+def valid_steps(ckpt_dir: str) -> List[int]:
+    """Ascending step numbers of the valid checkpoints under ``ckpt_dir``."""
+    root = _root(ckpt_dir)
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        int(d)
+        for d in os.listdir(root)
+        if d.isdigit() and is_valid_checkpoint(os.path.join(root, d))
+    )
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    root = _root(ckpt_dir)
-    if not os.path.isdir(root):
+    steps = valid_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+# A .tmp- dir older than this is presumed abandoned (its writer dead) and
+# swept; a younger one may be a live save (multi-host Orbax writes, or a
+# concurrent job sharing the ckpt_dir) and is left alone — a live Orbax
+# save is seconds to minutes.
+STALE_TMP_AGE_S = 3600.0
+
+
+def _sweep_stale_tmp(root: str, keep_name: Optional[str] = None) -> None:
+    """Remove leftover ``.tmp-`` dirs old enough that their writer is
+    certainly dead.  ``keep_name`` protects the current save's own tmp."""
+    now = time.time()
+    for d in os.listdir(root):
+        if not d.startswith(_TMP_PREFIX) or d == keep_name:
+            continue
+        full = os.path.join(root, d)
+        try:
+            if now - os.path.getmtime(full) <= STALE_TMP_AGE_S:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(full, ignore_errors=True)
+
+
+def tree_all_finite(tree: Any) -> bool:
+    """One fused device verdict: every floating/complex leaf is finite."""
+    import jax.numpy as jnp
+
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return True
+    verdict = jax.jit(
+        lambda ls: jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in ls]))
+    )(leaves)
+    return bool(verdict)
+
+
+def save_state(
+    ckpt_dir: str, step: int, state: Any, keep: Optional[int] = None,
+    require_finite: bool = True,
+) -> Optional[str]:
+    """Atomically write ``state`` under ``ckpt_dir/<step>``; returns the path.
+
+    Overwrites an existing same-step checkpoint so crash-resume re-saves
+    are idempotent.  ``keep=N`` prunes to the newest ``N`` steps after
+    saving (``keep=1`` is the reference's single-artifact "model_best"
+    convention).  A crash anywhere before the final rename leaves the
+    previous checkpoints untouched.
+
+    ``require_finite`` (default) refuses to save non-finite params —
+    logged and skipped, returning ``None``: a NaN-poisoned checkpoint
+    would validate (the digest proves integrity, not health) and become
+    the "newest valid" step that both plain resume and the divergence
+    guard's rollback would then faithfully restore.  The divergence can
+    strike between guard checks, so the save path must gate too.
+
+    Multi-host: every process calls this (Orbax coordinates the array
+    writes into the SHARED tmp dir); only process 0 touches the
+    filesystem around it (manifest, finalize rename, sweep, prune), and
+    all processes sync before returning so none races ahead to read
+    ``latest_step`` before the rename.
+    """
+    if require_finite and not tree_all_finite(getattr(state, "params", state)):
+        log.warning(
+            "skipping checkpoint save @%d: non-finite params (a NaN "
+            "checkpoint would poison newest-valid resume)", step,
+        )
         return None
-    steps = [int(d) for d in os.listdir(root) if d.isdigit()]
-    return max(steps) if steps else None
+    root = _root(ckpt_dir)
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, str(int(step)))
+    # Shared (not per-process) tmp name: on multi-host runs every process
+    # must hand Orbax the SAME path for its coordinated multi-process save.
+    tmp_name = f"{_TMP_PREFIX}{int(step)}"
+    tmp = os.path.join(root, tmp_name)
+    primary = jax.process_index() == 0
+    if primary and os.path.exists(tmp):
+        shutil.rmtree(tmp)
+
+    def _write():
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp, state, force=True)
+
+    try:
+        _with_retries(_write, f"checkpoint save @{step}")
+        if primary:
+            _write_manifest(
+                tmp, step, params_digest(getattr(state, "params", state))
+            )
+            # Fault hook: a preemption/SIGKILL landing here leaves only the
+            # unfinalized tmp dir — exactly what restore must survive.
+            inject.maybe_crash_mid_save(step)
+            if os.path.exists(final):
+                # Same-step re-save: never open a window with the old
+                # artifact deleted and the new one not yet in place (a
+                # crash there would eat the newest — possibly only —
+                # checkpoint).  Move the old step aside into the tmp
+                # namespace (atomic rename), finalize, then drop the aside.
+                aside = os.path.join(
+                    root, f"{_TMP_PREFIX}replaced-{int(step)}"
+                )
+                if os.path.exists(aside):
+                    shutil.rmtree(aside)
+                os.replace(final, aside)
+                os.replace(tmp, final)
+                shutil.rmtree(aside, ignore_errors=True)
+            else:
+                os.replace(tmp, final)
+    except OSError:
+        if primary:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if primary:
+        _sweep_stale_tmp(root)
+        if keep is not None:
+            for old in valid_steps(root)[:-keep]:
+                shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"dwt_ckpt_save_{int(step)}")
+    return final
+
+
+def _restore_one(path: str, template: Any) -> Any:
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+
+    def _read():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, abstract)
+
+    restored = _with_retries(_read, f"checkpoint restore {path}")
+    manifest = _read_manifest(path)
+    if manifest is not None and "params_digest" in manifest:
+        got = params_digest(getattr(restored, "params", restored))
+        if got != manifest["params_digest"]:
+            raise ValueError(
+                f"checkpoint {path} failed digest validation "
+                f"({got[:12]}… != manifest {manifest['params_digest'][:12]}…)"
+            )
+    return restored
 
 
 def restore_state(ckpt_dir: str, template: Any, step: Optional[int] = None) -> Any:
-    """Restore the checkpoint at ``step`` (default: latest) shaped like
-    ``template`` (a concrete or abstract ``TrainState``)."""
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = os.path.join(_root(ckpt_dir), str(int(step)))
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, abstract)
+    """Restore the checkpoint at ``step`` shaped like ``template``.
+
+    ``step=None`` restores the newest checkpoint that both validates and
+    restores, walking older candidates on failure (a torn or corrupted
+    newest checkpoint falls back instead of killing the resumed job).  An
+    explicit ``step`` must be valid and restore cleanly, or this raises.
+    """
+    root = _root(ckpt_dir)
+    if step is not None:
+        path = os.path.join(root, str(int(step)))
+        if not is_valid_checkpoint(path):
+            raise FileNotFoundError(
+                f"checkpoint step {step} under {ckpt_dir} is missing, "
+                "unfinalized, or truncated"
+            )
+        return _restore_one(path, template)
+
+    candidates = valid_steps(root)
+    errors: List[str] = []
+    for s in reversed(candidates):
+        path = os.path.join(root, str(s))
+        try:
+            restored = _restore_one(path, template)
+            if errors:
+                log.warning(
+                    "restored step %d after skipping invalid newer "
+                    "checkpoints: %s", s, "; ".join(errors),
+                )
+            return restored
+        except (OSError, ValueError) as e:
+            errors.append(f"step {s}: {e}")
+    raise FileNotFoundError(
+        f"no restorable checkpoints under {ckpt_dir}"
+        + (f" (tried: {'; '.join(errors)})" if errors else "")
+    )

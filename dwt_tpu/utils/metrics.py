@@ -8,6 +8,7 @@ and a machine-parseable JSON record, to stdout and optionally a JSONL file.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import IO, Optional
@@ -19,7 +20,12 @@ class MetricLogger:
         self._file = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.time()
 
-    def log(self, kind: str, step: int, **values: float) -> None:
+    def log(self, kind: str, step: int, sync: bool = False, **values: float) -> None:
+        """Emit one record.  ``sync=True`` fsyncs the JSONL file: records
+        that narrate a crash/preemption/rollback (the resilience layer's
+        ``preempt``/``divergence``/``rollback`` kinds) must survive the
+        process dying immediately after — an OS-buffered line would vanish
+        with exactly the evidence a post-mortem needs."""
         record = {
             "kind": kind,
             "step": int(step),
@@ -39,6 +45,8 @@ class MetricLogger:
         if self._file:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
+            if sync:
+                os.fsync(self._file.fileno())
 
     def close(self) -> None:
         if self._file:

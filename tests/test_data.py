@@ -274,6 +274,10 @@ def test_worker_pool_stochastic_augs_reproducible():
 
 
 def test_batch_iterator_worker_pool_propagates_errors():
+    """``quarantine=False`` restores fail-fast semantics: a corrupt item
+    surfaces at its position in order (the default quarantines instead —
+    covered by tests/test_resilience.py)."""
+
     class Corrupt:
         def __len__(self):
             return 16
@@ -283,7 +287,9 @@ def test_batch_iterator_worker_pool_propagates_errors():
                 raise OSError("truncated jpeg")
             return np.float32(i), i
 
-    stream = batch_iterator(Corrupt(), 4, shuffle=False, num_workers=4)
+    stream = batch_iterator(
+        Corrupt(), 4, shuffle=False, num_workers=4, quarantine=False
+    )
     got = [next(stream) for _ in range(2)]  # items 0..7 fine
     assert len(got) == 2
     with pytest.raises(OSError, match="truncated jpeg"):

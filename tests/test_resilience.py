@@ -1,0 +1,491 @@
+"""End-to-end recovery proofs for dwt_tpu.resilience (CPU, synthetic data).
+
+Every failure mode the resilience layer defends against is injected
+deterministically (dwt_tpu/resilience/inject.py) and the recovery path is
+driven to completion:
+
+* kill-mid-save -> resume picks the newest *valid* checkpoint;
+* truncated / digest-corrupt checkpoint -> newest-valid fallback;
+* NaN at step k -> the configured guard policy fires (halt raises,
+  skip_step continues from the in-memory snapshot, rollback restores the
+  newest valid checkpoint and trains to completion);
+* corrupt dataset item -> quarantined, epoch completes;
+* SIGTERM mid-training -> final checkpoint + exit code 0, on both the
+  per-step and steps_per_dispatch paths (subprocess tests).
+
+All tests are tier-1-safe: JAX_PLATFORMS=cpu (conftest), synthetic data,
+tiny models, no sleeps beyond subprocess polling.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.resilience import (
+    DivergenceError,
+    DivergenceGuard,
+    PreemptionHandler,
+    inject,
+)
+from dwt_tpu.resilience.inject import FaultPlan, FlakyDataset, SimulatedCrash
+from dwt_tpu.train import adam_l2, create_train_state
+from dwt_tpu.utils.checkpoint import (
+    MANIFEST,
+    is_valid_checkpoint,
+    latest_step,
+    params_digest,
+    restore_state,
+    save_state,
+    valid_steps,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No injected fault may leak between tests (plans are process-global)."""
+    yield
+    inject.disarm()
+
+
+def _tiny_state(step=0, scale=1.0):
+    model = LeNetDWT(group_size=4)
+    tx = adam_l2(1e-3)
+    sample = jnp.zeros((2, 4, 28, 28, 1), jnp.float32)
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    if scale != 1.0:
+        state = state.replace(
+            params=jax.tree.map(lambda x: x * scale, state.params)
+        )
+    return state.replace(step=state.step + step)
+
+
+# ------------------------------------------------- checkpoint validation
+
+
+def test_kill_mid_save_resumes_newest_valid(tmp_path):
+    """Acceptance (a): a crash between the checkpoint write and the atomic
+    finalize rename must leave the previous checkpoint authoritative."""
+    ck = str(tmp_path / "ck")
+    good = _tiny_state(step=1)
+    save_state(ck, 1, good)
+
+    inject.arm(FaultPlan(crash_in_save=True))
+    with pytest.raises(SimulatedCrash):
+        save_state(ck, 2, _tiny_state(step=2, scale=2.0))
+
+    # The torn save left no finalized "2": step 1 is still the newest
+    # valid checkpoint and restores bit-exact.
+    assert latest_step(ck) == 1
+    restored = restore_state(ck, good)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(good), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The next successful save finalizes AND sweeps the stale tmp dir.
+    inject.disarm()
+    save_state(ck, 2, _tiny_state(step=2))
+    assert latest_step(ck) == 2
+    assert not [d for d in os.listdir(ck) if d.startswith(".tmp-")]
+
+
+def test_truncated_checkpoint_falls_back(tmp_path):
+    """A checkpoint whose bytes on disk no longer match its manifest is
+    invalid; latest_step/restore_state fall back to the older step."""
+    ck = str(tmp_path / "ck")
+    s1 = _tiny_state(step=1)
+    save_state(ck, 1, s1)
+    save_state(ck, 2, _tiny_state(step=2, scale=2.0))
+    assert valid_steps(ck) == [1, 2]
+
+    # Truncate the largest non-manifest file of step 2 (a dead filesystem
+    # flushing a prefix of the array bytes).
+    step2 = os.path.join(ck, "2")
+    files = [
+        os.path.join(sub, n)
+        for sub, _, names in os.walk(step2)
+        for n in names
+        if n != MANIFEST
+    ]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(victim) // 2))
+
+    assert not is_valid_checkpoint(step2)
+    assert latest_step(ck) == 1
+    restored = restore_state(ck, s1)
+    assert int(restored.step) == 1
+    # An explicitly requested truncated step must refuse, not guess.
+    with pytest.raises(FileNotFoundError, match="truncated"):
+        restore_state(ck, s1, step=2)
+
+
+def test_digest_mismatch_falls_back(tmp_path):
+    """Sizes intact but content wrong (bit corruption): the post-restore
+    digest check rejects the checkpoint and fallback still works."""
+    ck = str(tmp_path / "ck")
+    s1 = _tiny_state(step=1)
+    save_state(ck, 1, s1)
+    save_state(ck, 2, _tiny_state(step=2))
+
+    manifest_path = os.path.join(ck, "2", MANIFEST)
+    manifest = json.load(open(manifest_path))
+    size = os.path.getsize(manifest_path)
+    manifest["params_digest"] = "0" * len(manifest["params_digest"])
+    raw = json.dumps(manifest, indent=1)
+    with open(manifest_path, "w") as f:
+        f.write(raw.ljust(size))  # keep the recorded size valid
+
+    assert is_valid_checkpoint(os.path.join(ck, "2"))  # sizes check out...
+    restored = restore_state(ck, s1)  # ...but restore rejects the digest
+    assert int(restored.step) == 1
+
+
+def test_nonfinite_state_is_never_checkpointed(tmp_path):
+    """A NaN-poisoned state must not become the newest 'valid' checkpoint:
+    the digest proves integrity, not health, so rollback/resume would
+    faithfully restore the poison.  save_state gates on finiteness."""
+    ck = str(tmp_path / "ck")
+    good = _tiny_state(step=1)
+    save_state(ck, 1, good)
+    bad = good.replace(
+        step=good.step + 1,
+        params=jax.tree.map(lambda x: x * jnp.nan, good.params),
+    )
+    assert save_state(ck, 2, bad) is None
+    assert latest_step(ck) == 1  # the poisoned save left no artifact
+    restored = restore_state(ck, good)
+    assert int(restored.step) == 1
+
+
+def test_params_digest_is_content_sensitive():
+    s = _tiny_state()
+    assert params_digest(s.params) == params_digest(s.params)
+    bumped = jax.tree.map(lambda x: x + 1, s.params)
+    assert params_digest(s.params) != params_digest(bumped)
+
+
+# ----------------------------------------------------- divergence guard
+
+
+def _digits_argv(tmp_path, **over):
+    base = {
+        "synthetic_size": 32,
+        "source_batch_size": 8,
+        "target_batch_size": 8,
+        "test_batch_size": 16,
+        "group_size": 4,
+        "epochs": 2,
+        "log_interval": 1,
+        "metrics_jsonl": str(tmp_path / "metrics.jsonl"),
+    }
+    base.update(over)
+    argv = ["--synthetic"]
+    for k, v in base.items():
+        argv += [f"--{k}", str(v)]
+    return argv
+
+
+def _records(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def test_guard_halt_raises_on_injected_nan(tmp_path):
+    from dwt_tpu.cli.usps_mnist import main
+
+    inject.arm(FaultPlan(nan_at_step=3))
+    with pytest.raises(DivergenceError, match="non-finite"):
+        main(_digits_argv(tmp_path, guard_policy="halt", guard_interval=1))
+    kinds = [r["kind"] for r in _records(tmp_path)]
+    assert "divergence" in kinds
+
+
+def test_guard_skip_step_recovers_and_completes(tmp_path):
+    from dwt_tpu.cli.usps_mnist import main
+
+    inject.arm(FaultPlan(nan_at_step=3))
+    acc = main(
+        _digits_argv(tmp_path, guard_policy="skip_step", guard_interval=1)
+    )
+    assert 0.0 <= acc <= 100.0
+    kinds = [r["kind"] for r in _records(tmp_path)]
+    assert "skip_step" in kinds
+    # Training ran past the divergence to the final-epoch eval.
+    tests = [r for r in _records(tmp_path) if r["kind"] == "test"]
+    assert tests and tests[-1]["epoch"] == 1
+    assert np.isfinite(tests[-1]["loss"])
+
+
+def test_guard_rollback_restores_checkpoint_and_completes(tmp_path):
+    """Acceptance (b): NaN at a step past the first epoch checkpoint; the
+    rollback policy restores that checkpoint, re-seeds the data order, and
+    the run still trains to completion with finite metrics."""
+    from dwt_tpu.cli.usps_mnist import main
+
+    ck = str(tmp_path / "ck")
+    inject.arm(FaultPlan(nan_at_step=6))  # epoch 1 (steps/epoch = 4)
+    acc = main(
+        _digits_argv(
+            tmp_path,
+            epochs=3,
+            guard_policy="rollback",
+            guard_interval=1,
+            ckpt_dir=ck,
+            ckpt_every_epochs=1,
+        )
+    )
+    assert 0.0 <= acc <= 100.0
+    recs = _records(tmp_path)
+    rollbacks = [r for r in recs if r["kind"] == "rollback"]
+    assert len(rollbacks) == 1
+    # Rolled back TO the epoch-1 checkpoint (step 4) FROM the poisoned step.
+    assert rollbacks[0]["step"] == 4
+    assert rollbacks[0]["from_step"] == 6
+    assert rollbacks[0]["source"] == "checkpoint"
+    tests = [r for r in recs if r["kind"] == "test"]
+    assert tests[-1]["epoch"] == 2 and np.isfinite(tests[-1]["loss"])
+    assert latest_step(ck) == 3 * 4
+
+
+def test_guard_rollback_chunked_path(tmp_path):
+    """The steps_per_dispatch path only regains host control at chunk
+    boundaries; a mid-chunk NaN must still be caught and rolled back."""
+    from dwt_tpu.cli.usps_mnist import main
+
+    ck = str(tmp_path / "ck")
+    inject.arm(FaultPlan(nan_at_step=6))
+    acc = main(
+        _digits_argv(
+            tmp_path,
+            epochs=3,
+            steps_per_dispatch=2,
+            guard_policy="rollback",
+            guard_interval=1,
+            ckpt_dir=ck,
+            ckpt_every_epochs=1,
+        )
+    )
+    assert 0.0 <= acc <= 100.0
+    recs = _records(tmp_path)
+    assert [r["kind"] for r in recs].count("rollback") == 1
+    tests = [r for r in recs if r["kind"] == "test"]
+    assert tests[-1]["epoch"] == 2 and np.isfinite(tests[-1]["loss"])
+
+
+def test_guard_rollback_without_checkpoint_uses_memory_snapshot(tmp_path):
+    from dwt_tpu.cli.usps_mnist import main
+
+    inject.arm(FaultPlan(nan_at_step=3))
+    acc = main(
+        _digits_argv(tmp_path, guard_policy="rollback", guard_interval=1)
+    )
+    assert 0.0 <= acc <= 100.0
+    rollbacks = [r for r in _records(tmp_path) if r["kind"] == "rollback"]
+    assert rollbacks and rollbacks[0]["source"] == "memory"
+
+
+def test_guard_gives_up_after_max_rollbacks():
+    guard = DivergenceGuard("rollback", interval=1, max_rollbacks=0)
+    guard.prime({"w": jnp.ones(2)})
+    bad = {"loss": jnp.asarray(float("nan"))}
+    with pytest.raises(DivergenceError, match="rollbacks already spent"):
+        guard.step({"w": jnp.ones(2)}, bad, 1, 1)
+
+
+def test_guard_rejects_bad_policy():
+    with pytest.raises(ValueError, match="guard policy"):
+        DivergenceGuard("none", interval=1)
+
+
+# ------------------------------------------------- data retry/quarantine
+
+
+class _Tiny:
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.float32(i), i
+
+
+def test_transient_item_failure_is_retried():
+    from dwt_tpu.data.loader import batch_iterator
+
+    ds = FlakyDataset(_Tiny(), fail={5: 1})  # item 5 fails once, then loads
+    got = list(batch_iterator(ds, 4, shuffle=False))
+    xs = np.concatenate([x for x, _ in got])
+    np.testing.assert_array_equal(xs, np.arange(16, dtype=np.float32))
+
+
+def test_corrupt_item_quarantined_epoch_completes():
+    """Acceptance (c): a corrupt item is logged and skipped; every other
+    item still arrives and the epoch finishes (boundaries shift by one)."""
+    from dwt_tpu.data.loader import batch_iterator
+
+    ds = FlakyDataset(_Tiny(), corrupt=(5,))
+    got = list(
+        batch_iterator(ds, 4, shuffle=False, drop_last=False, num_workers=2)
+    )
+    xs = np.concatenate([x for x, _ in got])
+    np.testing.assert_array_equal(
+        xs, np.asarray([i for i in range(16) if i != 5], np.float32)
+    )
+
+
+def test_quarantined_item_sharded_substitutes_to_keep_batch_count():
+    """Under shard=(index, count) a dropped item would desync the
+    per-process batch counts the sharding invariant protects (a ragged
+    tail deadlocks the collective); the bad item is replaced by a
+    duplicate of the nearest good item instead."""
+    from dwt_tpu.data.loader import batch_iterator
+
+    # Shard 0 of 2 sees even items 0,2,...,14; corrupt one of them.
+    ds = FlakyDataset(_Tiny(), corrupt=(4,))
+    got = list(
+        batch_iterator(ds, 4, shuffle=False, drop_last=True, shard=(0, 2))
+    )
+    assert len(got) == 2 and all(x.shape[0] == 4 for x, _ in got)
+    xs = np.concatenate([x for x, _ in got])
+    # Item 4's slot was filled by its predecessor, item 2.
+    np.testing.assert_array_equal(
+        xs, np.asarray([0, 2, 2, 6, 8, 10, 12, 14], np.float32)
+    )
+
+    # Corrupt FIRST item: the deficit is repaid by the first good item.
+    ds = FlakyDataset(_Tiny(), corrupt=(0,))
+    got = list(
+        batch_iterator(ds, 4, shuffle=False, drop_last=True, shard=(0, 2))
+    )
+    assert len(got) == 2 and all(x.shape[0] == 4 for x, _ in got)
+    assert float(got[0][0][0]) == 2.0  # duplicate of item 2 fills slot 0
+
+
+def test_quarantine_false_restores_fail_fast():
+    from dwt_tpu.data.loader import batch_iterator
+
+    ds = FlakyDataset(_Tiny(), corrupt=(1,))
+    with pytest.raises(OSError, match="corrupt"):
+        list(batch_iterator(ds, 4, shuffle=False, quarantine=False))
+
+
+def test_checkpoint_io_retry_backoff():
+    from dwt_tpu.utils.checkpoint import _with_retries
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert _with_retries(flaky, "t", retries=3, backoff_s=0.0) == "ok"
+    assert len(calls) == 3
+    with pytest.raises(OSError):
+        _with_retries(lambda: (_ for _ in ()).throw(OSError("x")), "t",
+                      retries=2, backoff_s=0.0)
+
+
+# ----------------------------------------------------------- preemption
+
+
+def test_preemption_handler_flag_and_restore():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as p:
+        assert not p.should_stop
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Signal delivery is synchronous for a self-kill on the main thread.
+        assert p.should_stop
+        assert p.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def _spawn_digits(tmp_path, extra=()):
+    ck = str(tmp_path / "ck")
+    jsonl = str(tmp_path / "m.jsonl")
+    argv = [
+        sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+        "--synthetic", "--synthetic_size", "32",
+        "--source_batch_size", "8", "--target_batch_size", "8",
+        "--test_batch_size", "16", "--group_size", "4",
+        "--epochs", "500", "--log_interval", "1",
+        "--ckpt_dir", ck, "--ckpt_every_epochs", "1000",
+        "--metrics_jsonl", jsonl, *extra,
+    ]
+    # conftest already pinned JAX_PLATFORMS=cpu and stripped the relay var
+    # from os.environ, so the child inherits a CPU-only config.
+    proc = subprocess.Popen(
+        argv, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    return proc, ck, jsonl
+
+
+def _wait_for_train_record(proc, jsonl, timeout=180.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "trainer exited before SIGTERM: "
+                + proc.stderr.read().decode(errors="replace")[-2000:]
+            )
+        if os.path.exists(jsonl):
+            for line in open(jsonl).read().splitlines():
+                if '"train"' in line:
+                    return
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("no train record within timeout")
+
+
+def _assert_graceful_exit(proc, ck, jsonl):
+    try:
+        rc = proc.wait(timeout=180)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise AssertionError("trainer did not exit after SIGTERM")
+    stderr = proc.stderr.read().decode(errors="replace")
+    assert rc == 0, f"exit code {rc}; stderr tail: {stderr[-2000:]}"
+    # A final checkpoint was saved even though ckpt_every_epochs never hit.
+    assert latest_step(ck) is not None
+    kinds = [json.loads(l)["kind"] for l in open(jsonl).read().splitlines()]
+    assert "preempt" in kinds
+
+
+@pytest.mark.parametrize("dispatch", ["1", "4"])
+def test_sigterm_saves_final_checkpoint_and_exits_zero(tmp_path, dispatch):
+    """Acceptance (d): SIGTERM mid-training -> final checkpoint, a preempt
+    record, exit 0 — on the per-step AND steps_per_dispatch paths."""
+    proc, ck, jsonl = _spawn_digits(
+        tmp_path, extra=("--steps_per_dispatch", dispatch)
+    )
+    try:
+        _wait_for_train_record(proc, jsonl)
+        proc.send_signal(signal.SIGTERM)
+        _assert_graceful_exit(proc, ck, jsonl)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_nan_injection_via_env_plan(tmp_path):
+    """The DWT_FAULT_PLAN env var arms subprocess runs (used to prove the
+    guard in a separately-spawned trainer); in-process, FaultPlan.from_env
+    must parse it identically."""
+    os.environ[inject.ENV_VAR] = json.dumps(
+        {"nan_at_step": 7, "crash_in_save": True}
+    )
+    try:
+        plan = FaultPlan.from_env()
+        assert plan.nan_at_step == 7 and plan.crash_in_save is True
+    finally:
+        del os.environ[inject.ENV_VAR]

@@ -10,6 +10,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+from jax.experimental import enable_x64
 
 from dwt_tpu.ops import (
     WhiteningStats,
@@ -116,7 +117,7 @@ def test_ema_accumulates_unshrunk_cov_with_momentum_on_new():
 def test_gradients_flow_and_match_finite_differences():
     x64 = make_input((2, 3, 3, 4), seed=13).astype(np.float64)
 
-    with jax.enable_x64(True):
+    with enable_x64():
         stats = WhiteningStats(
             mean=jnp.zeros(4, jnp.float64),
             cov=jnp.ones((1, 4, 4), jnp.float64),
