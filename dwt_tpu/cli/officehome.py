@@ -72,6 +72,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the converted weights")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_iters", type=int, default=d.ckpt_every_iters)
+    p.add_argument("--async_ckpt", action=argparse.BooleanOptionalAction,
+                   default=d.async_ckpt,
+                   help="background checkpoint pipeline: the loop only "
+                        "snapshots + enqueues; digest/Orbax write/rename "
+                        "run on a writer thread (--no-async_ckpt: every "
+                        "save blocks the loop)")
+    p.add_argument("--anchor_every", type=int, default=d.anchor_every,
+                   help=">0: every N iters also save an anchor checkpoint "
+                        "under ckpt_dir/anchors, exempt from any pruning — "
+                        "bounds rollback distance under repeated divergence")
     p.add_argument("--guard_policy",
                    choices=["none", "halt", "skip_step", "rollback"],
                    default=d.guard_policy,

@@ -49,6 +49,14 @@ class DigitsConfig:
     steps_per_dispatch: int = 1
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
+    # Background checkpoint pipeline (dwt_tpu.resilience.async_ckpt): the
+    # hot path only snapshots + enqueues; digest/Orbax write/rename run on
+    # a writer thread.  Off: every save blocks the loop (PR-1 behavior).
+    async_ckpt: bool = True
+    # >0: every N epochs also save an "anchor" checkpoint under
+    # ckpt_dir/anchors, exempt from any pruning — bounds rollback distance
+    # under repeated divergence.  0 = off.
+    anchor_every: int = 0
     bf16: bool = False
     # Divergence guard (dwt_tpu.resilience): amortized finite-check on
     # loss/grad-norm every guard_interval steps.  Policies: "none" (off),
@@ -103,6 +111,11 @@ class OfficeHomeConfig:
     init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
+    # Background checkpoint pipeline — see DigitsConfig.async_ckpt.
+    async_ckpt: bool = True
+    # >0: every N iters also save an anchor checkpoint under
+    # ckpt_dir/anchors (never pruned) — see DigitsConfig.anchor_every.
+    anchor_every: int = 0
     bf16: bool = False
     remat: bool = False  # jax.checkpoint per bottleneck (HBM for FLOPs)
     # Divergence guard — see DigitsConfig.guard_policy.

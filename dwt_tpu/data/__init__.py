@@ -38,6 +38,7 @@ from dwt_tpu.data.transforms import (
     warp_affine,
 )
 from dwt_tpu.data.loader import (
+    QuarantineRegistry,
     batch_iterator,
     infinite,
     prefetch_to_device,
@@ -61,6 +62,7 @@ __all__ = [
     "gaussian_blur",
     "random_affine",
     "warp_affine",
+    "QuarantineRegistry",
     "batch_iterator",
     "infinite",
     "prefetch_to_device",

@@ -13,10 +13,12 @@ standard JAX double-buffering pattern.
 from __future__ import annotations
 
 import collections
+import json
 import logging
+import os
 import queue
 import threading
-from typing import Callable, Iterable, Iterator, Optional, Tuple
+from typing import Callable, Dict, FrozenSet, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -34,8 +36,87 @@ ITEM_RETRIES = 1
 QUARANTINED = object()
 
 
+class QuarantineRegistry:
+    """Durable record of quarantined item ids, keyed by stream role.
+
+    A quarantined item (undecodable image, persistently failing read) is
+    skipped for the rest of the epoch — but a resumed run would pay the
+    full retry ladder for the same corrupt file every epoch, forever.
+    The registry persists the ids under the run's ``ckpt_dir``
+    (``quarantine.json``) so a resume skips known-bad items *without a
+    single access attempt*.
+
+    Keys separate index spaces ("source"/"target"): the same integer id
+    names different files in different datasets.  Writes are atomic
+    (tmp + replace), lock-guarded (quarantine fires from loader worker
+    threads), and MERGE with the ids already on disk first — multi-host
+    runs share a ckpt_dir, and a blind rewrite from one process's
+    in-memory view would erase every other process's entries.  The
+    read-merge-write is best-effort, not transactional: a cross-process
+    race can still drop the loser's newest id, which then simply
+    re-quarantines on its next failure.
+    """
+
+    FILENAME = "quarantine.json"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._known: Dict[str, set] = {}
+        self._merge_from_disk()
+
+    def _merge_from_disk(self) -> None:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            for k, v in raw.items():
+                self._known.setdefault(str(k), set()).update(int(i) for i in v)
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError, TypeError) as e:
+            # A torn/corrupt registry must not kill a resume; items will
+            # simply re-quarantine (and rewrite the file) as they fail.
+            log.warning("quarantine registry %s unreadable (%s); ignoring "
+                        "its contents", self.path, e)
+
+    @classmethod
+    def for_ckpt_dir(cls, ckpt_dir: str) -> "QuarantineRegistry":
+        return cls(os.path.join(
+            os.path.abspath(os.path.expanduser(ckpt_dir)), cls.FILENAME
+        ))
+
+    def known(self, key: str) -> FrozenSet[int]:
+        with self._lock:
+            return frozenset(self._known.get(key, ()))
+
+    def add(self, key: str, index: int) -> None:
+        with self._lock:
+            ids = self._known.setdefault(key, set())
+            if int(index) in ids:
+                return
+            ids.add(int(index))
+            self._merge_from_disk()  # keep concurrent writers additive
+            payload = {k: sorted(v) for k, v in self._known.items()}
+            # Per-process tmp name: multi-host runs share ckpt_dir, and
+            # two processes truncating the SAME tmp inode could replace a
+            # torn registry into place, losing every persisted id.
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            try:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, indent=1)
+                os.replace(tmp, self.path)
+            except OSError as e:
+                # Persistence is best-effort; in-memory quarantine still
+                # protects the current run.
+                log.warning("could not persist quarantine registry %s: %s",
+                            self.path, e)
+
+
 def _load_item(dataset, i: int, token, retries: int = ITEM_RETRIES,
-               quarantine: bool = True):
+               quarantine: bool = True,
+               known_bad: FrozenSet[int] = frozenset(),
+               on_quarantine: Optional[Callable[[int], None]] = None):
     """``dataset[i]`` under an item-seed context: stochastic transforms
     using ``ThreadLocalRng`` draw from a stream determined by ``token``
     alone, so augmentations are reproducible across worker counts.
@@ -47,7 +128,15 @@ def _load_item(dataset, i: int, token, retries: int = ITEM_RETRIES,
     undecodable image must not kill an epoch that is hours into a
     preemptible run.  ``quarantine=False`` restores fail-fast semantics
     (the last exception propagates) for callers that prefer to die loudly.
+
+    ``known_bad`` short-circuits items a :class:`QuarantineRegistry`
+    already condemned (no access attempt at all); ``on_quarantine`` is
+    called with the index when an item exhausts its retries here.  The
+    short-circuit honors ``quarantine=False``: fail-fast callers get the
+    real access attempt (and its loud exception), not a silent skip.
     """
+    if quarantine and int(i) in known_bad:
+        return QUARANTINED
     last: Optional[BaseException] = None
     for attempt in range(retries + 1):
         set_item_seed(token)
@@ -68,6 +157,8 @@ def _load_item(dataset, i: int, token, retries: int = ITEM_RETRIES,
         "quarantined item %d after %d attempts (%s: %s)",
         i, retries + 1, type(last).__name__, last,
     )
+    if on_quarantine is not None:
+        on_quarantine(int(i))
     return QUARANTINED
 
 
@@ -80,7 +171,10 @@ def _stack(parts):
 
 def _pooled_items(dataset, indices, num_workers: int, token_of,
                   retries: int = ITEM_RETRIES,
-                  quarantine: bool = True) -> Iterator:
+                  quarantine: bool = True,
+                  known_bad: FrozenSet[int] = frozenset(),
+                  on_quarantine: Optional[Callable[[int], None]] = None,
+                  ) -> Iterator:
     """Map ``dataset[i]`` over ``indices`` on a thread pool, in order.
 
     The TPU-native stand-in for DataLoader worker *processes*: PIL decode,
@@ -99,13 +193,17 @@ def _pooled_items(dataset, indices, num_workers: int, token_of,
     try:
         pending: "collections.deque" = collections.deque()
         for i in it:
-            pending.append(ex.submit(_load_item, dataset, i, token_of(i), retries, quarantine))
+            pending.append(ex.submit(_load_item, dataset, i, token_of(i),
+                                     retries, quarantine, known_bad,
+                                     on_quarantine))
             if len(pending) >= window:
                 break
         while pending:
             item = pending.popleft().result()
             for i in it:  # top the window back up
-                pending.append(ex.submit(_load_item, dataset, i, token_of(i), retries, quarantine))
+                pending.append(ex.submit(_load_item, dataset, i, token_of(i),
+                                     retries, quarantine, known_bad,
+                                     on_quarantine))
                 break
             yield item
     finally:
@@ -123,6 +221,8 @@ def batch_iterator(
     num_workers: int = 0,
     item_retries: int = ITEM_RETRIES,
     quarantine: bool = True,
+    quarantine_registry: Optional[QuarantineRegistry] = None,
+    quarantine_key: str = "items",
 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield tuples of stacked numpy batches from an indexable dataset.
 
@@ -149,7 +249,11 @@ def batch_iterator(
       nearest good item: dropping it would shorten only this process's
       epoch and desync the per-process batch counts the sharding
       invariant above exists to protect.  Pass ``quarantine=False`` to
-      re-raise after the retries instead.
+      re-raise after the retries instead;
+    * ``quarantine_registry``/``quarantine_key``: persist quarantined ids
+      (per stream role) so a resumed run skips known-bad items without a
+      single access attempt — the skipped item follows the same drop/
+      substitute semantics as a freshly quarantined one.
     """
     n = len(dataset)
     order = np.arange(n)
@@ -164,13 +268,20 @@ def batch_iterator(
     stop = len(order) - (len(order) % batch_size if drop_last else 0)
     indices = order[:stop]
     token_of = lambda i: (seed, epoch, int(i))
+    known_bad: FrozenSet[int] = frozenset()
+    on_quarantine = None
+    if quarantine_registry is not None:
+        known_bad = quarantine_registry.known(quarantine_key)
+        on_quarantine = lambda i: quarantine_registry.add(quarantine_key, i)
     if num_workers and num_workers > 1:
         items_iter = _pooled_items(
-            dataset, indices, num_workers, token_of, item_retries, quarantine
+            dataset, indices, num_workers, token_of, item_retries,
+            quarantine, known_bad, on_quarantine,
         )
     else:
         items_iter = (
-            _load_item(dataset, i, token_of(i), item_retries, quarantine)
+            _load_item(dataset, i, token_of(i), item_retries, quarantine,
+                       known_bad, on_quarantine)
             for i in indices
         )
 

@@ -12,12 +12,16 @@ provable in CI on CPU:
   boundaries; final checkpoint + clean exit 0 on SIGTERM/SIGINT.
 * :class:`DivergenceGuard` — amortized jitted finite-checks with
   ``halt`` / ``skip_step`` / ``rollback`` recovery policies.
+* :class:`AsyncCheckpointer` — single-in-flight background checkpoint
+  pipeline (snapshot → digest → write off the hot path; rendezvous via
+  ``flush()`` at preemption/final/rollback/best-record points).
 * atomic validated checkpoints live in :mod:`dwt_tpu.utils.checkpoint`
   (write-to-tmp + rename, per-step manifest, newest-valid fallback);
   retry/quarantine item loading lives in :mod:`dwt_tpu.data.loader`.
 """
 
 from dwt_tpu.resilience import inject
+from dwt_tpu.resilience.async_ckpt import AsyncCheckpointer, snapshot_state
 from dwt_tpu.resilience.guard import (
     POLICIES,
     DivergenceError,
@@ -27,6 +31,8 @@ from dwt_tpu.resilience.guard import (
 from dwt_tpu.resilience.preemption import PreemptionHandler
 
 __all__ = [
+    "AsyncCheckpointer",
+    "snapshot_state",
     "DivergenceError",
     "DivergenceGuard",
     "POLICIES",

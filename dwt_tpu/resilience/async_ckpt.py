@@ -1,0 +1,190 @@
+"""Asynchronous checkpoint pipeline: snapshot → digest → write, off the
+hot path.
+
+``utils.checkpoint.save_state`` is safe (atomic finalize, manifest digest,
+newest-valid fallback) but synchronous: the train loop stalls for a
+device→host fetch, a full SHA-256 over the param tree, and an Orbax
+serialize + fsync + rename before the next step can dispatch.  At the
+flagship's ~8-95 ms/step that multi-second stall at ``ckpt_every`` cadence
+is a pure throughput tax that grows with model size.
+
+:class:`AsyncCheckpointer` splits a save into a cheap hot-path half and a
+background half:
+
+* **hot path** — :meth:`save` deep-copies the state into fresh
+  *non-donated* device buffers (``jnp.copy`` per leaf: dispatch only, no
+  host sync — the runtime orders the copy before any later donation of the
+  source buffers) and enqueues the task.  The loop dispatches its next
+  step immediately.
+* **writer thread** — runs the existing ``save_state`` wholesale: finite
+  gate, Orbax write, SHA-256 manifest, atomic rename, prune, and (multi-
+  host) the process-0-finalize + cross-process barrier.  Reusing the
+  primitive keeps the on-disk format byte-identical to a synchronous save,
+  so every restore/fallback path is unchanged.
+
+Correctness rules the train loops must follow (and do — ``train/loop.py``):
+
+* **single in-flight** — a second :meth:`save` arriving while one is
+  running joins it first (backpressure), never queues unboundedly.
+* **rendezvous** — :meth:`flush` joins the in-flight save; required before
+  anything that must observe the checkpoint durably on disk: preemption
+  save-and-exit, the final save, guard rollback/restore (the newest valid
+  checkpoint must include the in-flight one, and the writer must not race
+  the restore's directory walk), and best-record updates (``best.json``
+  must never point at an artifact that does not exist yet).
+* **errors surface, never vanish** — a writer exception is re-raised on
+  the next :meth:`save`/:meth:`flush` (the failed save is logged; the new
+  save is *not* silently dropped — the caller sees the failure exactly
+  like a synchronous save raising).
+
+Multi-host: NOT supported — the writer thread dispatches device work
+(the finite-gate jit, ``save_state``'s cross-process barrier) whose
+launch order relative to the main thread's train-step collectives is
+thread-scheduling dependent, and multi-host JAX requires an identical
+collective launch order on every process (mismatch = runtime deadlock).
+The train loops therefore downgrade ``--async_ckpt`` to the synchronous
+save path when ``jax.process_count() > 1``; a collective-free writer
+(host-side snapshot, pure-I/O task) is the future lift for multi-host.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
+
+
+# One compiled whole-tree copy, not per-leaf eager jnp.copy: eager dispatch
+# of ~75 small ops contends with a busy compute queue (measured: the
+# per-leaf form stalls 15→170 ms as the dispatch queue deepens; the jitted
+# form stays ~1 ms).  jit never donates by default, so the outputs are
+# fresh buffers, and it follows the inputs' shardings on DP/multi-host
+# states.  Cached per (structure, shapes) by jit itself.
+_snapshot_fn = None
+
+
+def snapshot_state(state: Any) -> Any:
+    """Deep-copy ``state`` into fresh non-donated device buffers.
+
+    Dispatch-only: no host transfer, no sync.  The copy must happen on the
+    enqueueing thread — JAX orders it before any later donation of the
+    source buffers by the next train step, which a copy issued from the
+    writer thread could race.
+    """
+    global _snapshot_fn
+    if _snapshot_fn is None:
+        _snapshot_fn = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+    return _snapshot_fn(state)
+
+
+class AsyncCheckpointer:
+    """Single-in-flight background checkpoint writer (see module doc).
+
+    Thread model: at most one writer thread alive at a time; ``save``
+    joins any previous writer before starting the next (backpressure).
+    All public methods are main-thread only — the loops drive saves from
+    one thread, so no internal locking is needed beyond the join.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._error_step: Optional[int] = None
+        self._last_path: Optional[str] = None
+        self._pending_step: Optional[int] = None
+
+    # ------------------------------------------------------------- internals
+
+    def _run(self, targets, step: int, snapshot: Any) -> None:
+        # Deferred import: utils.checkpoint imports resilience.inject, so a
+        # module-level import here would be circular via the package init.
+        from dwt_tpu.utils.checkpoint import save_state
+
+        try:
+            for ckpt_dir, kwargs in targets:
+                path = save_state(ckpt_dir, step, snapshot, **kwargs)
+                if path is not None:  # None = refused (non-finite), no artifact
+                    self._last_path = path
+        except BaseException as e:  # surfaced on the next enqueue/flush
+            self._error = e
+            self._error_step = step
+            log.warning("async checkpoint save @%d failed: %s", step, e)
+
+    def _join(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+            self._pending_step = None
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            e, step = self._error, self._error_step
+            self._error = self._error_step = None
+            log.error("surfacing failed async checkpoint save @%s", step)
+            raise e
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def in_flight(self) -> Optional[int]:
+        """Step of the save currently being written, or None."""
+        return self._pending_step
+
+    def save(self, ckpt_dir: str, step: int, state: Any, **kwargs) -> None:
+        """Snapshot ``state`` and enqueue its save; returns immediately
+        unless a previous save is still in flight (backpressure join).
+
+        ``kwargs`` pass through to ``save_state`` (``keep=``,
+        ``require_finite=``).  A previous writer failure is raised HERE,
+        before the new save is enqueued, so no failure is ever swallowed
+        between rendezvous points.
+        """
+        self.save_multi([(ckpt_dir, kwargs)], step, state)
+
+    def save_multi(self, targets, step: int, state: Any) -> None:
+        """One snapshot, several directory writes in a single writer task.
+
+        ``targets`` is ``[(ckpt_dir, save_state_kwargs), ...]``.  A
+        coinciding cadence boundary (periodic save + its same-step anchor)
+        must cost the hot path ONE enqueue — two sequential ``save`` calls
+        would make the second's backpressure join block the loop for the
+        first save's full writer duration, reintroducing the sync stall on
+        exactly those steps.
+        """
+        self._join()
+        self._raise_pending()
+        snapshot = snapshot_state(state)
+        self._pending_step = int(step)
+        self._thread = threading.Thread(
+            target=self._run,
+            args=(list(targets), int(step), snapshot),
+            name=f"dwt-ckpt-writer-{int(step)}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def flush(self) -> Optional[str]:
+        """Join the in-flight save (if any); raise its error if it failed.
+
+        Returns the path of the most recent successfully finalized
+        checkpoint (None if no save has completed yet).
+        """
+        self._join()
+        self._raise_pending()
+        return self._last_path
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Final rendezvous.  ``raise_errors=False`` is for abnormal-exit
+        cleanup paths where a writer error must not mask the original
+        exception (it is still logged by the writer)."""
+        if raise_errors:
+            self.flush()
+            return
+        self._join()
+        self._error = self._error_step = None

@@ -53,12 +53,14 @@ def _snapshot(state: Any) -> Any:
     A plain reference is NOT enough: the ``steps_per_dispatch`` paths
     donate the input state's buffers to the compiled step, so a kept
     reference would be invalidated by the very next dispatch.  Fresh
-    buffers survive donation.
+    buffers survive donation.  Delegates to the async checkpointer's
+    jitted whole-tree copy: this runs on the hot path every passing
+    guard check, where the eager per-leaf form stalls tens of ms against
+    a deep dispatch queue (measured in async_ckpt.py).
     """
-    import jax
-    import jax.numpy as jnp
+    from dwt_tpu.resilience.async_ckpt import snapshot_state
 
-    return jax.tree.map(jnp.copy, state)
+    return snapshot_state(state)
 
 
 class DivergenceGuard:

@@ -17,7 +17,9 @@ single-host data parallelism over all local devices.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import logging
 import os
 from typing import Callable, Optional, Tuple
 
@@ -32,6 +34,7 @@ from dwt_tpu.data import (
     FusedAffineBlurNormalize,
     FusedToArrayNormalize,
     ImageFolderDataset,
+    QuarantineRegistry,
     RandomCrop,
     RandomHorizontalFlip,
     Resize,
@@ -46,6 +49,7 @@ from dwt_tpu.data import (
 )
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
 from dwt_tpu.resilience import (
+    AsyncCheckpointer,
     DivergenceError,
     DivergenceGuard,
     PreemptionHandler,
@@ -62,7 +66,14 @@ from dwt_tpu.train.steps import (
     make_stat_collection_step,
     stack_batches,
 )
-from dwt_tpu.utils import MetricLogger, latest_step, restore_state, save_state
+from dwt_tpu.utils import (
+    MetricLogger,
+    restore_state,
+    save_state,
+    valid_steps,
+)
+
+log = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------- helpers
@@ -323,16 +334,122 @@ def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
 # diverged would be the one guaranteed-useless retry).
 _ROLLBACK_SEED_STRIDE = 7919
 
+# Anchor checkpoints (--anchor_every) live in a subdirectory of ckpt_dir:
+# nothing ever prunes or overwrites there, so under repeated divergence the
+# rollback distance is bounded by the anchor cadence even if every
+# checkpoint in the main directory has been torn, poisoned, or pruned.
+ANCHOR_SUBDIR = "anchors"
+
+
+def _anchor_dir(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, ANCHOR_SUBDIR)
+
+
+class _CkptPipeline:
+    """One save/flush facade per training run: async by default
+    (:class:`AsyncCheckpointer` — the hot path only snapshots + enqueues),
+    synchronous ``save_state`` with ``--no-async_ckpt``.
+
+    ``flush()`` is the rendezvous the loops call wherever the checkpoint
+    must be durably on disk before proceeding: preemption save-and-exit,
+    the final save, guard rollback/restore, and best-record updates.  On
+    the sync path it is a no-op (every save already blocked).
+    """
+
+    def __init__(self, cfg):
+        use_async = bool(cfg.ckpt_dir) and getattr(cfg, "async_ckpt", True)
+        if use_async and jax.process_count() > 1:
+            # The writer thread dispatches device work (finite-gate jit,
+            # save barrier) in a thread-scheduling-dependent order relative
+            # to the main thread's train-step collectives; multi-host JAX
+            # requires an identical collective launch order on every
+            # process (mismatch = deadlock).  Downgrade to the proven
+            # synchronous path — see async_ckpt.py module docstring.
+            log.warning(
+                "--async_ckpt is single-process only; multi-host run "
+                "falls back to synchronous checkpoint saves"
+            )
+            use_async = False
+        self._acp = AsyncCheckpointer() if use_async else None
+
+    def save(self, ckpt_dir: str, step: int, state, **kwargs) -> None:
+        self.save_multi([(ckpt_dir, kwargs)], step, state)
+
+    def save_multi(self, targets, step: int, state) -> None:
+        """``targets = [(dir, kwargs), ...]`` written from ONE snapshot in
+        one writer task — a coinciding boundary (periodic + anchor) costs
+        one enqueue, not a blocking backpressure join per directory."""
+        if self._acp is not None:
+            self._acp.save_multi(targets, step, state)
+        else:
+            for ckpt_dir, kwargs in targets:
+                save_state(ckpt_dir, step, state, **kwargs)
+
+    def save_sync(self, ckpt_dir: str, step: int, state, **kwargs):
+        """Join any in-flight save, then save on THIS thread and return
+        ``save_state``'s result — None when the save was refused
+        (non-finite params, no artifact).  For saves whose outcome gates
+        a follow-up action (the best-record update): the async writer
+        deliberately swallows a refusal (it is not an error), so a caller
+        that must know cannot go through the queue."""
+        self.flush()
+        return save_state(ckpt_dir, step, state, **kwargs)
+
+    def flush(self) -> None:
+        if self._acp is not None:
+            self._acp.flush()
+
+    def close(self, raise_errors: bool = True) -> None:
+        if self._acp is not None:
+            self._acp.close(raise_errors=raise_errors)
+
+
+def _ranked_checkpoints(ckpt_dir: str):
+    """Every valid checkpoint across the main dir and its anchors as
+    ``(step, is_main, source, dir)``, newest step first (ties — a step
+    saved to both dirs — prefer the main dir)."""
+    ranked = []
+    for src, d in (("checkpoint", ckpt_dir), ("anchor", _anchor_dir(ckpt_dir))):
+        for s in valid_steps(d):
+            ranked.append((s, src == "checkpoint", src, d))
+    ranked.sort(reverse=True)
+    return ranked
+
+
+def _restore_newest(ckpt_dir: str, template, ranked=None):
+    """Restore the newest step that validates AND restores, ranked by
+    STEP across the main dir and the anchors dir; ``(state, source)`` or
+    None.  Ranking whole directories instead would let a size-valid but
+    digest-corrupt newest main checkpoint drag the restore to an
+    arbitrarily old main-dir step while a newer valid anchor sits ignored
+    — exactly the rollback-distance bound anchors exist to provide.  Both
+    plain resume and guard rollback go through this, so the two recovery
+    paths agree on what "newest" means.  ``ranked`` reuses a
+    ``_ranked_checkpoints`` walk the caller already paid for (validation
+    stats every manifest-listed file — costly on networked storage).
+    """
+    if ranked is None:
+        ranked = _ranked_checkpoints(ckpt_dir)
+    for s, _, src, d in ranked:
+        try:
+            return restore_state(d, template, step=s), src
+        except (OSError, ValueError):
+            continue
+    return None
+
 
 def _rollback_state(cfg, logger, guard: DivergenceGuard, template, failed_step):
     """Recovery state for a ``rollback`` policy hit: the newest valid
-    on-disk checkpoint, else the guard's last in-memory good state."""
+    on-disk checkpoint (anchors included), else the guard's last
+    in-memory good state.  Callers flush the async checkpoint pipeline
+    BEFORE calling, so the in-flight save is on disk and the writer
+    cannot race this directory walk.
+    """
     restored, source = None, "checkpoint"
     if cfg.ckpt_dir:
-        try:
-            restored = restore_state(cfg.ckpt_dir, template)
-        except FileNotFoundError:
-            restored = None
+        out = _restore_newest(cfg.ckpt_dir, template)
+        if out is not None:
+            restored, source = out
     if restored is None:
         restored, source = guard.good_state, "memory"
     if restored is None:
@@ -508,10 +625,22 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
     )
     start_epoch = 0
-    if cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None:
-        state = restore_state(cfg.ckpt_dir, state)
+    ranked_resume = _ranked_checkpoints(cfg.ckpt_dir) if cfg.ckpt_dir else []
+    if ranked_resume:
+        # Resume ranks anchors too: if the main dir's checkpoints were all
+        # torn or pruned, restarting from step 0 past a valid anchor would
+        # discard exactly the progress anchors exist to bound.
+        resumed = _restore_newest(cfg.ckpt_dir, state, ranked_resume)
+        if resumed is None:
+            # Candidates existed but none restored — die loudly rather
+            # than silently retrain from scratch over them.
+            raise FileNotFoundError(
+                f"no restorable checkpoints under {cfg.ckpt_dir} "
+                "(main or anchors)"
+            )
+        state, src = resumed
         start_epoch = int(state.step) // steps_per_epoch
-        logger.log("resume", int(state.step), epoch=start_epoch)
+        logger.log("resume", int(state.step), epoch=start_epoch, source=src)
 
     raw_step = make_digits_train_step(
         model,
@@ -540,20 +669,30 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     guard = _make_guard(cfg, logger)
     if guard:
         guard.prime(state)
+    ckpt = _CkptPipeline(cfg)
+    qreg = (
+        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
+    )
     acc = 0.0
     epoch = start_epoch
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
     gstep = int(state.step)  # host-side global step count (guard/injection)
-    with PreemptionHandler(logger) as preempt:
+    with contextlib.ExitStack() as _cleanup, PreemptionHandler(logger) as preempt:
+        # Abnormal-exit rendezvous: join (don't abandon) a live writer
+        # thread; errors were already logged and must not mask the
+        # original exception.  Normal paths flush explicitly first.
+        _cleanup.callback(lambda: ckpt.close(raise_errors=False))
         while epoch < cfg.epochs:
             source_iter = batch_iterator(
                 source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
                 epoch=epoch, shard=shard, num_workers=cfg.num_workers,
+                quarantine_registry=qreg, quarantine_key="source",
             )
             target_iter = batch_iterator(
                 target_ds, local_bs, shuffle=True,
                 seed=cfg.seed + 1 + seed_bump, epoch=epoch, shard=shard,
                 num_workers=cfg.num_workers,
+                quarantine_registry=qreg, quarantine_key="target",
             )
 
             def epoch_batches():
@@ -632,6 +771,13 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         on_steps,
                     )
             except RollbackRequest as rb:
+                # Rendezvous: JOIN the in-flight save so the writer cannot
+                # race the restore's directory walk — but do NOT re-raise
+                # a stale writer error here: a failed periodic save
+                # (transient disk-full, already logged) must not abort the
+                # recovery path when an older valid checkpoint or the
+                # in-memory snapshot could still save the run.
+                ckpt.close(raise_errors=False)
                 state = _rollback_state(cfg, logger, guard, state, rb.step)
                 gstep = int(state.step)
                 epoch = gstep // steps_per_epoch
@@ -650,9 +796,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 target_iter.close()
             if preempt.should_stop:
                 # Preemption grace windows are short: save and get out —
-                # skip the per-epoch eval, return with exit code 0.
+                # skip the per-epoch eval, return with exit code 0.  The
+                # flush rendezvous makes the final checkpoint durable
+                # before the process exits.  Clear any STALE writer error
+                # first (already logged): an old failed periodic save must
+                # not block the final save this exit-0 contract promises —
+                # only the final save's OWN failure may surface here.
                 if cfg.ckpt_dir:
-                    save_state(cfg.ckpt_dir, int(state.step), state)
+                    ckpt.close(raise_errors=False)
+                    ckpt.save(cfg.ckpt_dir, int(state.step), state)
+                    ckpt.flush()
                 logger.log("preempt", int(state.step), epoch=epoch, sync=True)
                 return acc
             result = _evaluate(
@@ -661,12 +814,22 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             )
             acc = result["accuracy"]
             logger.log("test", int(state.step), epoch=epoch, **result)
+            targets = []
             if cfg.ckpt_dir and (
                 (epoch + 1) % cfg.ckpt_every_epochs == 0
                 or epoch == cfg.epochs - 1
             ):
-                save_state(cfg.ckpt_dir, int(state.step), state)
+                targets.append((cfg.ckpt_dir, {}))
+            if cfg.ckpt_dir and cfg.anchor_every and (
+                (epoch + 1) % cfg.anchor_every == 0
+            ):
+                targets.append((_anchor_dir(cfg.ckpt_dir), {}))
+            if targets:
+                ckpt.save_multi(targets, int(state.step), state)
             epoch += 1
+        # Final rendezvous: surface any writer failure while the run can
+        # still exit nonzero, and leave no dangling writer thread.
+        ckpt.flush()
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
     return acc
 
@@ -773,8 +936,9 @@ def run_officehome(
     # Init priority when NOT resuming a crashed/finished run: a converted
     # Orbax artifact (--init_ckpt, read-only — see dwt-convert) beats the
     # inline torch conversion (--resnet_path). A resume checkpoint in
-    # --ckpt_dir supersedes both below.
-    resuming = cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None
+    # --ckpt_dir (anchors included) supersedes both below.
+    ranked_resume = _ranked_checkpoints(cfg.ckpt_dir) if cfg.ckpt_dir else []
+    resuming = bool(ranked_resume)
     if cfg.init_ckpt and not resuming:
         state = restore_state(cfg.init_ckpt, state)
         state = state.replace(step=jnp.zeros_like(state.step))
@@ -801,14 +965,22 @@ def run_officehome(
 
     start_iter = 0
     best_acc = -1.0
-    if cfg.ckpt_dir and latest_step(cfg.ckpt_dir) is not None:
-        state = restore_state(cfg.ckpt_dir, state)
+    if resuming:
+        resumed = _restore_newest(cfg.ckpt_dir, state, ranked_resume)
+        if resumed is None:
+            # Candidates existed (so --init_ckpt was skipped) but none
+            # restored: die loudly rather than silently train from init.
+            raise FileNotFoundError(
+                f"no restorable checkpoints under {cfg.ckpt_dir} "
+                "(main or anchors)"
+            )
+        state, src = resumed
         start_iter = int(state.step)
         # Resume-only: a from-scratch restart (no periodic checkpoint) must
         # not inherit a stale best record from a dead trajectory — its
         # model_best would never update.
         best_acc = _read_best_record(cfg.ckpt_dir)
-        logger.log("resume", start_iter)
+        logger.log("resume", start_iter, source=src)
 
     raw_step = make_officehome_train_step(
         model,
@@ -822,6 +994,10 @@ def run_officehome(
     collect_step = jax.jit(make_stat_collection_step(eval_model, num_domains=3))
 
     acc = 0.0
+    ckpt = _CkptPipeline(cfg)
+    qreg = (
+        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
+    )
 
     def _log_train(it, step_no, cls, mec):
         # Callers guard on the log cadence BEFORE evaluating the metric
@@ -843,18 +1019,32 @@ def run_officehome(
             if cfg.ckpt_dir and acc > best_acc:
                 # The reference's "model_best_gr_N" convention: keep the
                 # highest-target-accuracy state (the published checkpoint is
-                # exactly such an artifact, README.md:11).
-                best_acc = acc
-                save_state(
+                # exactly such an artifact, README.md:11).  Synchronous on
+                # purpose (joins any in-flight save first): best.json must
+                # never name an artifact that is not durably finalized —
+                # and a REFUSED save (non-finite params, no artifact, no
+                # error) must not update the record either, or a resume
+                # would seed best_acc above every real checkpoint and
+                # model_best would never update again.
+                best_path = ckpt.save_sync(
                     os.path.join(cfg.ckpt_dir, f"best_gr_{cfg.group_size}"),
                     int(state.step),
                     state,
                     keep=1,
                 )
-                _write_best_record(cfg.ckpt_dir, acc, int(state.step))
-                logger.log("best", int(state.step), accuracy=acc)
+                if best_path is not None:
+                    best_acc = acc
+                    _write_best_record(cfg.ckpt_dir, acc, int(state.step))
+                    logger.log("best", int(state.step), accuracy=acc)
+        targets = []
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
-            save_state(cfg.ckpt_dir, int(state.step), state)
+            targets.append((cfg.ckpt_dir, {}))
+        if cfg.ckpt_dir and cfg.anchor_every and (
+            (it + 1) % cfg.anchor_every == 0
+        ):
+            targets.append((_anchor_dir(cfg.ckpt_dir), {}))
+        if targets:
+            ckpt.save_multi(targets, int(state.step), state)
 
     # Overlap host-side decode/augmentation with device compute (the aug
     # pipeline is the expensive host stage for OfficeHome); the per-item
@@ -864,7 +1054,9 @@ def run_officehome(
     if guard:
         guard.prime(state)
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
-    with PreemptionHandler(logger) as preempt:
+    with contextlib.ExitStack() as _cleanup, PreemptionHandler(logger) as preempt:
+        # Abnormal-exit rendezvous for the async writer (see run_digits).
+        _cleanup.callback(lambda: ckpt.close(raise_errors=False))
         # Rollback retry loop: each attempt builds fresh (re-seeded)
         # streams and trains from the current state; a RollbackRequest
         # restores the newest valid checkpoint and starts a new attempt.
@@ -873,13 +1065,17 @@ def run_officehome(
                 lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
                                          seed=cfg.seed + seed_bump, epoch=e,
                                          shard=shard,
-                                         num_workers=cfg.num_workers)
+                                         num_workers=cfg.num_workers,
+                                         quarantine_registry=qreg,
+                                         quarantine_key="source")
             )
             target_stream = infinite(
                 lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
                                          seed=cfg.seed + 1 + seed_bump,
                                          epoch=e, shard=shard,
-                                         num_workers=cfg.num_workers)
+                                         num_workers=cfg.num_workers,
+                                         quarantine_registry=qreg,
+                                         quarantine_key="target")
             )
 
             def train_batches():
@@ -931,6 +1127,8 @@ def run_officehome(
                     should_cut = lambda i: (
                         (i + 1) % cfg.check_acc_step == 0
                         or (cfg.ckpt_dir and (i + 1) % cfg.ckpt_every_iters == 0)
+                        or (cfg.ckpt_dir and cfg.anchor_every
+                            and (i + 1) % cfg.anchor_every == 0)
                     )
                     it = start_iter
 
@@ -966,6 +1164,9 @@ def run_officehome(
                         state, batches, raw_step, make_chunked, {}, on_steps,
                     )
             except RollbackRequest as rb:
+                # Non-raising rendezvous before restore (see run_digits
+                # rollback: a stale writer error must not abort recovery).
+                ckpt.close(raise_errors=False)
                 state = _rollback_state(cfg, logger, guard, state, rb.step)
                 start_iter = int(state.step)
                 seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
@@ -986,11 +1187,18 @@ def run_officehome(
 
         if preempt.should_stop:
             # Save and get out inside the grace window; skip the
-            # stat-collection protocol (a resumed run redoes it).
+            # stat-collection protocol (a resumed run redoes it).  Flush:
+            # the checkpoint must be durable before the exit-0 return.
+            # Stale writer errors are cleared first (see run_digits).
             if cfg.ckpt_dir:
-                save_state(cfg.ckpt_dir, int(state.step), state)
+                ckpt.close(raise_errors=False)
+                ckpt.save(cfg.ckpt_dir, int(state.step), state)
+                ckpt.flush()
             logger.log("preempt", int(state.step), sync=True)
             return acc
+        # Training done: surface any in-flight writer failure before the
+        # stat-collection protocol spends more device time.
+        ckpt.flush()
 
     # Post-training protocol: N gradient-free train-mode passes over the
     # target TEST set with tripled data to re-estimate target stats
@@ -1016,5 +1224,8 @@ def run_officehome(
     logger.log("final_test", int(state.step), **result)
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
     if cfg.ckpt_dir:
-        save_state(cfg.ckpt_dir, int(state.step), state)
+        # Post-stat-collection state is the run's artifact; save + flush
+        # (effectively synchronous — nothing overlaps a final save).
+        ckpt.save(cfg.ckpt_dir, int(state.step), state)
+        ckpt.flush()
     return acc

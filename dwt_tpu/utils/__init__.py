@@ -5,6 +5,7 @@ from dwt_tpu.utils.checkpoint import (
     latest_step,
     restore_state,
     save_state,
+    valid_steps,
 )
 from dwt_tpu.utils.repro import (
     accuracy_verdict,
@@ -18,6 +19,7 @@ __all__ = [
     "latest_step",
     "restore_state",
     "save_state",
+    "valid_steps",
     "accuracy_verdict",
     "check_cli_accuracy",
     "load_expect_table",

@@ -56,6 +56,32 @@ def test_digits_cli_synthetic_with_resume(tmp_path):
     assert latest_step(ckpt) == 3 * (32 // 8)
     assert 0.0 <= acc2 <= 100.0
 
+    # Anchor resume: with every main-dir checkpoint gone (torn/pruned),
+    # resume must pick up the newest valid ANCHOR instead of silently
+    # retraining from scratch.
+    import json
+    import shutil
+
+    from dwt_tpu.train.loop import _anchor_dir
+
+    anchors = _anchor_dir(ckpt)
+    os.makedirs(anchors, exist_ok=True)
+    newest = latest_step(ckpt)
+    shutil.move(os.path.join(ckpt, str(newest)), os.path.join(anchors, str(newest)))
+    for d in list(os.listdir(ckpt)):
+        if d.isdigit():
+            shutil.rmtree(os.path.join(ckpt, d))
+    jsonl3 = tmp_path / "metrics3.jsonl"
+    acc3 = main(args[:-6] + ["--epochs", "4", "--ckpt_dir", ckpt,
+                             "--ckpt_every_epochs", "1",
+                             "--metrics_jsonl", str(jsonl3)])
+    assert 0.0 <= acc3 <= 100.0
+    resumes = [json.loads(l) for l in jsonl3.read_text().splitlines()
+               if json.loads(l)["kind"] == "resume"]
+    assert resumes and resumes[0]["step"] == newest
+    assert resumes[0]["source"] == "anchor"
+    assert latest_step(ckpt) == 4 * (32 // 8)
+
 
 @pytest.mark.slow
 def test_digits_loop_data_parallel(tmp_path):

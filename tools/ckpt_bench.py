@@ -1,0 +1,174 @@
+"""Checkpoint-stall microbench: per-save training-loop stall, sync vs async.
+
+A synchronous ``save_state`` blocks the train loop for a device→host
+fetch, a SHA-256 over the param tree, and an Orbax serialize + fsync +
+atomic rename.  The async pipeline (``dwt_tpu.resilience.async_ckpt``)
+charges the loop only a snapshot (``jnp.copy`` per leaf, dispatch-only)
+plus a thread handoff; everything else runs on the writer thread and
+overlaps the following train steps.
+
+This tool measures exactly that hot-path stall: the wall time of the save
+CALL alone.  Between saves it dispatches train-ish steps and then DRAINS
+the device queue (untimed), and on the async path it joins the writer
+(untimed) before the next timed enqueue — the regime the pipeline is
+designed for, where the checkpoint cadence (minutes in production)
+comfortably exceeds one save's duration (seconds).  Measuring with a
+congested queue would charge the sync path for queue drain and the async
+path for backpressure, i.e. measure the cadence configuration, not the
+pipeline.  The writer's own wall time is reported separately — the stall
+moved off the loop, it did not disappear.
+
+Prints one JSON line:
+``{"model": ..., "sync_save_ms": X, "async_enqueue_ms": Y,
+   "stall_reduction_x": X/Y, "async_writer_ms": ..., ...}``
+
+Acceptance gate for the ISSUE-2 pipeline: ``stall_reduction_x >= 5`` on
+CPU.  Run with ``JAX_PLATFORMS=cpu python tools/ckpt_bench.py``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_state(model_name: str, batch: int):
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.nn import LeNetDWT, ResNetDWT
+    from dwt_tpu.train import adam_l2, create_train_state
+
+    tx = adam_l2(1e-3)
+    if model_name == "lenet":
+        model = LeNetDWT(group_size=4)
+        sample = jnp.zeros((2, batch, 28, 28, 1), jnp.float32)
+    elif model_name == "tiny-resnet":
+        model = ResNetDWT(stage_sizes=(1, 1, 1, 1), num_classes=10,
+                          group_size=4)
+        sample = jnp.zeros((3, batch, 32, 32, 3), jnp.float32)
+    else:
+        raise SystemExit(f"unknown --model {model_name!r}")
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    return state, sample
+
+
+def make_busywork(state):
+    """A stand-in train step: enough dispatched device work between saves
+    that the async path is measured against a busy queue, as in training."""
+    import jax
+
+    @jax.jit
+    def bump(s):
+        return s.replace(
+            step=s.step + 1,
+            params=jax.tree.map(lambda x: x * 0.999, s.params),
+        )
+
+    return bump
+
+
+def _advance(state, bump, steps: int):
+    """Dispatch ``steps`` steps, then drain the queue (untimed): both
+    modes are measured against a quiet device, so the save-call timing is
+    the save's own cost, not queue-drain attribution."""
+    import jax
+
+    for _ in range(steps):
+        state = bump(state)
+    jax.block_until_ready(jax.tree.leaves(state))
+    return state
+
+
+def bench_sync(state, bump, ckpt_dir: str, saves: int, steps_between: int):
+    from dwt_tpu.utils.checkpoint import save_state
+
+    stalls = []
+    for k in range(saves):
+        state = _advance(state, bump, steps_between)
+        t0 = time.perf_counter()
+        save_state(ckpt_dir, int(k + 1), state)
+        stalls.append(time.perf_counter() - t0)
+    return stalls, state
+
+
+def bench_async(state, bump, ckpt_dir: str, saves: int, steps_between: int):
+    from dwt_tpu.resilience import AsyncCheckpointer
+
+    acp = AsyncCheckpointer()
+    stalls, writer = [], []
+    for k in range(saves):
+        state = _advance(state, bump, steps_between)
+        t0 = time.perf_counter()
+        acp.save(ckpt_dir, int(k + 1), state)
+        stalls.append(time.perf_counter() - t0)
+        # Untimed writer join before the next timed enqueue: production
+        # cadence >> save duration, so a real loop's next save never hits
+        # backpressure — the join's cost is reported, not hidden.
+        t0 = time.perf_counter()
+        acp.flush()
+        writer.append(time.perf_counter() - t0)
+    return stalls, writer, state
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="per-save loop stall, sync vs async")
+    p.add_argument("--model", choices=["lenet", "tiny-resnet"], default="lenet")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--saves", type=int, default=6,
+                   help="timed saves per mode (one shared untimed warmup "
+                        "save runs first: Orbax lazily builds its type-"
+                        "handler registry and the finite-check jit "
+                        "compiles on the first save)")
+    p.add_argument("--steps_between", type=int, default=4,
+                   help="dispatched train-ish steps between saves")
+    p.add_argument("--ckpt_dir", type=str, default=None,
+                   help="scratch directory (default: a fresh temp dir)")
+    args = p.parse_args(argv)
+
+    state, _ = build_state(args.model, args.batch)
+    bump = make_busywork(state)
+    state = bump(state)  # compile outside the timed region
+
+    scratch = args.ckpt_dir or tempfile.mkdtemp(prefix="dwt_ckpt_bench_")
+    sync_dir = os.path.join(scratch, "sync")
+    async_dir = os.path.join(scratch, "async")
+    try:
+        # One untimed warmup save (Orbax registry + XLA finite-check jit).
+        from dwt_tpu.utils.checkpoint import save_state
+
+        save_state(os.path.join(scratch, "warmup"), 0, state)
+
+        sync_stalls, state = bench_sync(
+            state, bump, sync_dir, args.saves, args.steps_between
+        )
+        async_stalls, writer, state = bench_async(
+            state, bump, async_dir, args.saves, args.steps_between
+        )
+
+        sync_ms = statistics.median(sync_stalls) * 1e3
+        async_ms = statistics.median(async_stalls) * 1e3
+        record = {
+            "model": args.model,
+            "saves": args.saves,
+            "steps_between": args.steps_between,
+            "sync_save_ms": round(sync_ms, 3),
+            "async_enqueue_ms": round(async_ms, 3),
+            "stall_reduction_x": round(sync_ms / max(async_ms, 1e-9), 1),
+            "async_writer_ms": round(statistics.median(writer) * 1e3, 3),
+        }
+        print(json.dumps(record))
+        return record
+    finally:
+        if args.ckpt_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
