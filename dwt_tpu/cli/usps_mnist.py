@@ -71,6 +71,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between guard finite-checks (each check is "
                         "one host sync; NaN is absorbing, so detection is "
                         "at most interval-1 steps late)")
+    p.add_argument("--guard_max_rollbacks", type=int,
+                   default=d.guard_max_rollbacks,
+                   help="rollback attempts before the guard halts the run")
+    p.add_argument("--guard_lr_backoff", type=float, default=d.guard_lr_backoff,
+                   help="in (0,1): first guard rung — revert to the last "
+                        "good in-memory state and scale optimizer updates "
+                        "by this factor (e.g. 0.5); recovers to 1.0 after "
+                        "--guard_backoff_recovery clean checks, escalates "
+                        "to --guard_policy if it strikes again while "
+                        "backed off.  0 disables the rung")
+    p.add_argument("--guard_backoff_recovery", type=int,
+                   default=d.guard_backoff_recovery,
+                   help="clean guard checks before a backed-off lr "
+                        "recovers to 1.0 (re-arming the backoff rung)")
+    p.add_argument("--watchdog_timeout", type=float, default=d.watchdog_timeout,
+                   help=">0: hang watchdog — if no step boundary completes "
+                        "for this many seconds, dump all-thread stacks "
+                        "under ckpt_dir/watchdog/ and exit 113 so the "
+                        "scheduler relaunches into resume; budget for the "
+                        "first step's compile and boundary evals.  0 = off")
+    p.add_argument("--keep_ckpts", type=int, default=d.keep_ckpts,
+                   help=">0: prune the main --ckpt_dir to the newest N "
+                        "steps after each periodic/final save; anchors "
+                        "(--anchor_every) and best_* artifacts live in "
+                        "separate directories and are never pruned")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--expect_accuracy", type=float, default=None,

@@ -49,6 +49,10 @@ class DigitsConfig:
     steps_per_dispatch: int = 1
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
+    # >0: prune the MAIN ckpt_dir to the newest N steps after each
+    # periodic/final save (anchors and best_* artifacts are separate
+    # directories and never touched).  0 = keep everything.
+    keep_ckpts: int = 0
     # Background checkpoint pipeline (dwt_tpu.resilience.async_ckpt): the
     # hot path only snapshots + enqueues; digest/Orbax write/rename run on
     # a writer thread.  Off: every save blocks the loop (PR-1 behavior).
@@ -65,6 +69,17 @@ class DigitsConfig:
     guard_policy: str = "none"
     guard_interval: int = 50
     guard_max_rollbacks: int = 3
+    # In (0, 1): first guard rung — on divergence, revert to the last
+    # good in-memory state and scale optimizer updates by this factor
+    # (recovering to 1.0 after guard_backoff_recovery clean checks);
+    # a strike while backed off escalates to guard_policy.  0 = off.
+    guard_lr_backoff: float = 0.0
+    guard_backoff_recovery: int = 3
+    # >0: hang watchdog — no step-boundary heartbeat for this many
+    # seconds dumps all-thread stacks under ckpt_dir/watchdog/ and exits
+    # WATCHDOG_EXIT_CODE (113) so schedulers relaunch into resume.
+    # Budget for the first step's jit compile and boundary evals.  0 = off.
+    watchdog_timeout: float = 0.0
 
 
 @dataclasses.dataclass
@@ -111,6 +126,9 @@ class OfficeHomeConfig:
     init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000
+    # >0: prune the MAIN ckpt_dir to the newest N steps after each save
+    # (anchors/best_* exempt) — see DigitsConfig.keep_ckpts.
+    keep_ckpts: int = 0
     # Background checkpoint pipeline — see DigitsConfig.async_ckpt.
     async_ckpt: bool = True
     # >0: every N iters also save an anchor checkpoint under
@@ -122,3 +140,8 @@ class OfficeHomeConfig:
     guard_policy: str = "none"
     guard_interval: int = 50
     guard_max_rollbacks: int = 3
+    # Guard lr-backoff rung — see DigitsConfig.guard_lr_backoff.
+    guard_lr_backoff: float = 0.0
+    guard_backoff_recovery: int = 3
+    # Hang watchdog — see DigitsConfig.watchdog_timeout.
+    watchdog_timeout: float = 0.0

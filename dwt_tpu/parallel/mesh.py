@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -58,6 +59,7 @@ def initialize_distributed(
     process before any device access.  (Reference has no analogue — it is
     single-process; SURVEY §5 distributed-backend note.)
     """
+    _maybe_enable_cpu_collectives()
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -66,3 +68,28 @@ def initialize_distributed(
     if process_id is not None:
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Multi-process runs on the CPU backend (CI, the 2-process consensus
+    tests, laptop bring-up) need a cross-process collectives backend: the
+    default CPU client refuses multiprocess computations outright on the
+    jax 0.4.x line.  Select gloo when (a) the chosen platform is CPU,
+    (b) the installed jax still exposes the knob (newer releases default
+    it), and (c) the user hasn't chosen an implementation themselves.
+    TPU/GPU backends bring their own collectives and are untouched.
+    """
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
+    )
+    if platforms.split(",")[0].strip() != "cpu":
+        return
+    values = getattr(jax.config, "values", {})
+    if "jax_cpu_collectives_implementation" not in values:
+        return  # newer jax: CPU collectives are built in / default gloo
+    current = values["jax_cpu_collectives_implementation"]
+    if current and current != "none":
+        return  # explicit user choice wins ('none' is the unset default)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")

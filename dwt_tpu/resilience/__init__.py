@@ -1,17 +1,28 @@
 """dwt_tpu.resilience — keep long preemptible runs alive and honest.
 
-Production TPU training dies three ways the reference code never had to
-survive: the scheduler preempts the VM (SIGTERM, short grace window), the
-numerics diverge (a Cholesky NaN poisons every later step), and I/O fails
-half-way (torn checkpoints, undecodable dataset items).  This package
-provides the three corresponding defenses, plus deterministic fault
-injection (:mod:`~dwt_tpu.resilience.inject`) so every recovery path is
-provable in CI on CPU:
+Production TPU training dies more ways than the reference code ever had
+to survive: the scheduler preempts a VM (SIGTERM, short grace window —
+possibly on ONE host of a pod), the numerics diverge (a Cholesky NaN
+poisons every later step), I/O fails half-way (torn checkpoints,
+undecodable dataset items), and sometimes nothing happens at all (a
+deadlocked collective burning allocation silently).  This package
+provides the corresponding defenses, plus deterministic fault injection
+(:mod:`~dwt_tpu.resilience.inject`) so every recovery path is provable
+in CI on CPU:
 
 * :class:`PreemptionHandler` — flag-only signal handler polled at step
   boundaries; final checkpoint + clean exit 0 on SIGTERM/SIGINT.
-* :class:`DivergenceGuard` — amortized jitted finite-checks with
-  ``halt`` / ``skip_step`` / ``rollback`` recovery policies.
+* :class:`Coordinator` — multi-host consensus: host-local stop/diverged
+  flags are allgathered at every step boundary, so any-host SIGTERM or
+  divergence becomes an all-host save/skip/rollback decision instead of
+  a hung collective.  Single-process runs short-circuit at zero cost.
+* :class:`DivergenceGuard` — amortized jitted finite-checks with an
+  escalation ladder: optional ``lr_backoff`` rung (gentle replay via an
+  injectable optimizer scale), then ``skip_step`` / ``rollback`` /
+  ``halt``.
+* :class:`HangWatchdog` — heartbeat-fed stall detector; dumps all-thread
+  stacks under ``ckpt_dir/watchdog/`` and exits
+  :data:`WATCHDOG_EXIT_CODE` so schedulers relaunch into the resume path.
 * :class:`AsyncCheckpointer` — single-in-flight background checkpoint
   pipeline (snapshot → digest → write off the hot path; rendezvous via
   ``flush()`` at preemption/final/rollback/best-record points).
@@ -22,6 +33,7 @@ provable in CI on CPU:
 
 from dwt_tpu.resilience import inject
 from dwt_tpu.resilience.async_ckpt import AsyncCheckpointer, snapshot_state
+from dwt_tpu.resilience.coord import Coordinator, Decision
 from dwt_tpu.resilience.guard import (
     POLICIES,
     DivergenceError,
@@ -29,14 +41,19 @@ from dwt_tpu.resilience.guard import (
     RollbackRequest,
 )
 from dwt_tpu.resilience.preemption import PreemptionHandler
+from dwt_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE, HangWatchdog
 
 __all__ = [
     "AsyncCheckpointer",
     "snapshot_state",
+    "Coordinator",
+    "Decision",
     "DivergenceError",
     "DivergenceGuard",
+    "HangWatchdog",
     "POLICIES",
     "PreemptionHandler",
     "RollbackRequest",
+    "WATCHDOG_EXIT_CODE",
     "inject",
 ]

@@ -1,0 +1,146 @@
+"""Multi-host failure consensus: any-host event → all-host decision.
+
+A multi-host run dies differently from a single process: SIGTERM lands on
+*one* host (schedulers preempt VMs independently), and a divergence or
+rollback decided host-locally desynchronizes the collective program —
+the "surviving" hosts block forever inside the next all-reduce while the
+decided host is saving or restoring.  Every failure decision must
+therefore be *global* before any host acts on it.
+
+:class:`Coordinator` makes that cheap: at each step/chunk boundary the
+loops call :meth:`decide` with their host-local flags (``stop`` from the
+preemption handler, the guard's divergence ``event`` code, and a
+``rollback_step`` proposal); the flags are allgathered as one tiny int
+vector (``multihost_utils.process_allgather`` — a single small
+collective that every host issues at the same boundary, so launch order
+stays identical) and combined: any host stopping stops all, the MAX
+event code across hosts governs everyone (halt > rollback > in-memory
+recovery > none — a host whose metrics looked finite mirrors the most
+severe remote rung), and the rollback target is the max over proposals
+(hosts run in step lock, so proposals agree; ``-1`` marks "no
+proposal").
+
+:meth:`agree_step` picks the rollback *restore* target: the **min** over
+each host's newest locally-restorable checkpoint step — the newest step
+every host can actually restore, guarding against rename-visibility skew
+on shared filesystems (one host's directory listing trailing another's
+finalize by a beat).
+
+Single-process runs short-circuit: :meth:`decide` returns the local
+flags without touching any collective or device API — the PR-1 behavior
+at zero overhead.  ``enabled=True`` forces the allgather path even at
+``process_count() == 1`` (it degenerates to a 1-row gather), which is how
+CI exercises the consensus code on a single host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+# Divergence event codes carried in the consensus flag vector, ordered by
+# severity: the max across hosts is the decision everyone acts on.
+EVENT_NONE = 0  # finite metrics, no guard action
+EVENT_RECOVERED = 1  # in-memory rung fired (lr_backoff or skip_step)
+EVENT_ROLLBACK = 2  # rollback requested (rollback_step carries the step)
+EVENT_HALT = 3  # guard says stop the run
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """The agreed all-host verdict for one step boundary."""
+
+    stop: bool  # some host was preempted: save and exit 0, together
+    event: int  # max EVENT_* code across hosts: the rung everyone takes
+    rollback_step: int  # failed step of a rollback proposal; -1 = none
+
+    @property
+    def diverged(self) -> bool:
+        return self.event != EVENT_NONE
+
+
+class Coordinator:
+    """Boundary consensus over (stop, diverged, rollback_step) flags.
+
+    One instance per training run.  ``enabled`` defaults to "multi-host
+    only" (``jax.process_count() > 1``); pass ``True`` to force the
+    collective path in single-process tests/dryruns.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None):
+        import jax
+
+        self.process_count = jax.process_count()
+        self.enabled = (
+            self.process_count > 1 if enabled is None else bool(enabled)
+        )
+
+    @property
+    def multi_host(self) -> bool:
+        return self.enabled
+
+    @staticmethod
+    def _allgather(values) -> np.ndarray:
+        """``[process_count, len(values)]`` rows of every host's vector.
+
+        One home for the gather idiom: int32 wire format (the values are
+        tiny flags/steps) and the 1-process shape normalization (a forced
+        single-process gather comes back without the leading axis).
+        """
+        from jax.experimental import multihost_utils
+
+        flags = np.asarray(list(values), np.int32)
+        return np.asarray(
+            multihost_utils.process_allgather(flags)
+        ).reshape(-1, flags.size)
+
+    def decide(
+        self,
+        stop: bool = False,
+        event: int = EVENT_NONE,
+        rollback_step: int = -1,
+    ) -> Decision:
+        """Combine each host's local flags into one global decision.
+
+        Must be called at the SAME boundary on every host (the loops call
+        it once per step/chunk) — it is a collective when enabled, and a
+        plain passthrough (no device work at all) otherwise.
+        """
+        if not self.enabled:
+            return Decision(bool(stop), int(event), int(rollback_step))
+        gathered = self._allgather(
+            [int(bool(stop)), int(event), int(rollback_step)]
+        )
+        return Decision(
+            stop=bool(gathered[:, 0].any()),
+            event=int(gathered[:, 1].max()),
+            rollback_step=int(gathered[:, 2].max()),
+        )
+
+    def agree_step(self, step: int) -> int:
+        """The newest checkpoint step EVERY host can restore: min over
+        each host's proposal (``-1`` = "nothing restorable here")."""
+        if not self.enabled:
+            return int(step)
+        return int(self._allgather([int(step)]).min())
+
+    def assert_same(self, value: int, what: str) -> None:
+        """Verify every host computed the same ``value``; raise loudly
+        otherwise.  The agreement protocols are best-effort against
+        visibility skew (a pruned or torn artifact can still make one
+        host restore something different than agreed) — a diagnosed halt
+        beats silently training forked replicas.
+        """
+        if not self.enabled:
+            return
+        gathered = self._allgather([int(value)]).reshape(-1)
+        if len(set(int(v) for v in gathered)) > 1:
+            raise RuntimeError(
+                f"multi-host desync on {what}: per-process values "
+                f"{[int(v) for v in gathered]} — refusing to continue "
+                "with forked replicas (check shared-checkpoint-dir "
+                "visibility/pruning)"
+            )

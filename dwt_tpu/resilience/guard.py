@@ -1,4 +1,4 @@
-"""Divergence guard: amortized finite-checks with a recovery policy.
+"""Divergence guard: amortized finite-checks with an escalation ladder.
 
 The DWT forward path runs a Cholesky factorization per whitening site per
 step; ill-conditioned batch covariances can (rarely) produce a NaN/Inf
@@ -11,16 +11,24 @@ single jitted boolean verdict at check boundaries.  NaN is absorbing
 (poisoned params keep producing NaN losses), so an amortized check still
 catches any divergence, at most ``interval - 1`` steps late.
 
-Policies on detection:
+Recovery is a LADDER, mildest rung first:
 
-* ``halt`` — raise :class:`DivergenceError`; the scheduler/operator sees
-  a failed job instead of a silently-ruined one.
-* ``skip_step`` — revert to the in-memory snapshot taken at the last
-  passing check and continue with fresh batches (drops at most
-  ``interval`` steps of progress; no disk I/O).
+* ``lr_backoff`` (optional first rung, ``lr_backoff`` in (0, 1)) —
+  revert to the in-memory snapshot from the last passing check AND scale
+  the optimizer's updates by the factor (via the injectable
+  :func:`~dwt_tpu.train.optim.scale_by_backoff` state — no recompile, no
+  disk I/O).  A *transient* spike thus costs at most ``interval`` steps
+  replayed gently; after ``backoff_recovery`` consecutive clean checks
+  the scale recovers to 1.0 and the rung re-arms.  A divergence striking
+  *while backed off* is persistent — escalate to the configured policy.
+* ``skip_step`` — revert to the in-memory snapshot and continue with
+  fresh batches (no disk I/O).
 * ``rollback`` — raise :class:`RollbackRequest`; the training loop
   restores the newest *valid* on-disk checkpoint and re-seeds its data
   streams so the replayed segment draws a different batch order.
+* ``halt`` — raise :class:`DivergenceError`; the scheduler/operator sees
+  a failed job instead of a silently-ruined one.  ``rollback`` escalates
+  here after ``max_rollbacks`` attempts.
 """
 
 from __future__ import annotations
@@ -70,21 +78,52 @@ class DivergenceGuard:
         interval: int,
         logger=None,
         max_rollbacks: int = 3,
+        lr_backoff: float = 0.0,
+        backoff_recovery: int = 3,
     ):
         if policy not in POLICIES or policy == "none":
             raise ValueError(
                 f"guard policy must be one of {POLICIES[1:]}; got {policy!r}"
             )
+        if lr_backoff and not (0.0 < lr_backoff < 1.0):
+            raise ValueError(
+                "guard lr_backoff must be a scale factor in (0, 1) "
+                f"(0 disables the rung); got {lr_backoff!r}"
+            )
         self.policy = policy
         self.interval = max(1, int(interval))
         self.max_rollbacks = max_rollbacks
         self.rollbacks = 0
+        self.lr_backoff = float(lr_backoff or 0.0)
+        self.backoff_recovery = max(1, int(backoff_recovery))
+        self.backoffs = 0  # lifetime count of rung-1 engagements
+        # Count of IN-MEMORY recoveries (lr_backoff + skip_step): these
+        # rungs return a state instead of raising, so the step-boundary
+        # consensus reads this counter to learn that a recovery fired
+        # and broadcast it to the other hosts.
+        self.recoveries = 0
+        self._scale = 1.0  # current backoff scale (host mirror)
+        self._clean_checks = 0  # passing checks since the scale dropped
         self._logger = logger
         self._since_check = 0
         self._good: Optional[Any] = None
+        # Snapshot from the passing check BEFORE the latest one: a host
+        # mirroring a remote divergence at this boundary must revert to
+        # the state the remote host reverted to — and the remote host
+        # never refreshed its snapshot at this boundary (its check
+        # failed), while this host's passing check just did.
+        self._prev_good: Optional[Any] = None
         self._verdict_fn = None
 
     # ------------------------------------------------------------- internals
+
+    @property
+    def _keeps_good(self) -> bool:
+        # The backoff rung reverts to the in-memory snapshot too (NaN is
+        # absorbing: reducing lr without discarding poisoned params would
+        # train NaN at a smaller step size), so it needs one even under
+        # the halt policy.
+        return self.policy in ("skip_step", "rollback") or self.lr_backoff > 0
 
     def _finite(self, metrics) -> bool:
         """One host sync: jitted all-finite verdict over loss + grad norm.
@@ -108,13 +147,31 @@ class DivergenceGuard:
         if self._logger is not None:
             self._logger.log(kind, step, sync=True, **values)
 
+    def _set_scale(self, state: Any, scale: float) -> Any:
+        from dwt_tpu.train.optim import set_backoff_scale
+
+        self._scale = float(scale)
+        return state.replace(
+            opt_state=set_backoff_scale(state.opt_state, scale)
+        )
+
     # ------------------------------------------------------------------ API
 
     def prime(self, state: Any) -> None:
         """Record the initial known-good state (pre-training or post-resume),
         so a divergence before the first passing check is still recoverable."""
-        if self.policy in ("skip_step", "rollback"):
+        if self.lr_backoff > 0:
+            from dwt_tpu.train.optim import has_backoff
+
+            if not has_backoff(state.opt_state):
+                raise ValueError(
+                    "guard lr_backoff needs an optimizer wrapped with "
+                    "dwt_tpu.train.optim.with_lr_backoff (no "
+                    "BackoffScaleState in the opt state)"
+                )
+        if self._keeps_good:
             self._good = _snapshot(state)
+            self._prev_good = self._good
 
     @property
     def good_state(self) -> Optional[Any]:
@@ -123,10 +180,24 @@ class DivergenceGuard:
             return None
         return _snapshot(self._good)
 
+    @property
+    def in_backoff(self) -> bool:
+        return self._scale != 1.0
+
+    def reapply_backoff(self, state: Any) -> Any:
+        """Re-impose the current backoff scale on a state restored from
+        disk (whose saved scale predates the backoff): the segment
+        replayed after a rollback escalation trains gently too."""
+        if not self.in_backoff:
+            return state
+        self._clean_checks = 0
+        return self._set_scale(state, self._scale)
+
     def step(self, state: Any, metrics: Any, n_steps: int, step_no: int) -> Any:
         """Account ``n_steps`` finished steps whose latest metrics are
         ``metrics``; run the amortized check when due.  Returns the state
-        to continue from (replaced under ``skip_step`` recovery).
+        to continue from (replaced under ``lr_backoff``/``skip_step``
+        recovery).
 
         ``metrics`` may hold device arrays — they are only fetched at
         check boundaries, so the async dispatch pipeline stays full
@@ -137,15 +208,63 @@ class DivergenceGuard:
             return state
         self._since_check = 0
         if self._finite(metrics):
-            if self.policy in ("skip_step", "rollback"):
+            if self.in_backoff:
+                self._clean_checks += 1
+                if self._clean_checks >= self.backoff_recovery:
+                    state = self._set_scale(state, 1.0)
+                    self._log("lr_recover", step_no, scale=1.0,
+                              clean_checks=self._clean_checks)
+            if self._keeps_good:
+                self._prev_good = self._good
                 self._good = _snapshot(state)
             return state
         return self._diverged(state, step_no)
 
+    def mirror_recovery(self, state: Any, step_no: int) -> Any:
+        """Perform the divergence rung WITHOUT a local verdict: the
+        step-boundary consensus reported another host's guard fired while
+        this host's metrics looked finite (a host-local fault preceding
+        the collective).  Hosts run the same guard config in step lock,
+        so the local ladder takes the same rung the remote one did —
+        keeping the replicated state identical across processes.  May
+        raise exactly like a local detection (escalation is global too).
+
+        This host's check PASSED at this boundary, refreshing ``_good``
+        to the current state — a snapshot the remote (failed-check) host
+        never took.  Reverting must target the snapshot BOTH hosts hold,
+        the one from the previous passing check, so the refresh is
+        rolled back first.
+        """
+        if self._prev_good is not None:
+            self._good = self._prev_good
+        return self._diverged(state, step_no)
+
     def _diverged(self, state: Any, step_no: int) -> Any:
-        self._log("divergence", step_no, policy=self.policy)
+        self._log(
+            "divergence", step_no, policy=self.policy, scale=self._scale
+        )
+        if self.lr_backoff and not self.in_backoff and self._good is not None:
+            # Rung 1: revert to the last good state, train gently.  Only
+            # when not ALREADY backed off — a strike at reduced lr is
+            # persistent and falls through to the configured policy.
+            self.backoffs += 1
+            self.recoveries += 1
+            self._clean_checks = 0
+            recovered = self._set_scale(self.good_state, self.lr_backoff)
+            self._log("lr_backoff", step_no, scale=self.lr_backoff,
+                      backoffs=self.backoffs)
+            return recovered
         if self.policy == "skip_step" and self._good is not None:
             self._log("skip_step", step_no)
+            self.recoveries += 1
+            self._clean_checks = 0  # a backed-off skip re-earns recovery
+            if self.in_backoff:
+                # The snapshot predates the backoff engagement (no passing
+                # check since), so its opt state still carries scale 1.0 —
+                # re-impose the rung or the "gentle" replay would run at
+                # exactly the lr that just diverged (and the host mirror
+                # would desync from the device scale).
+                return self._set_scale(self.good_state, self._scale)
             return self.good_state
         if self.policy == "rollback":
             if self.rollbacks >= self.max_rollbacks:

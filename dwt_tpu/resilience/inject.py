@@ -1,29 +1,47 @@
 """Deterministic fault injection for the resilience subsystem.
 
-Production training must survive four failure classes that are impossible
-to reproduce on demand with real hardware: numeric divergence (a NaN loss
-at some step), a preemption/crash landing *inside* a checkpoint save, a
-checkpoint truncated by a dead filesystem, and a corrupt/undecodable
-dataset item.  This module provides deterministic stand-ins for each,
-consulted by the production code at exactly the points the real fault
-would strike:
+Production training must survive failure classes that are impossible to
+reproduce on demand with real hardware: numeric divergence (a NaN loss at
+some step — possibly a *burst* of consecutive NaN steps), a
+preemption/crash landing *inside* a checkpoint save, a checkpoint
+truncated by a dead filesystem, a corrupt/undecodable dataset item, a
+preemption SIGTERM landing on one host of a multi-host run, a hung
+collective/step, a transiently slow step, and flaky checkpoint I/O.  This
+module provides deterministic stand-ins for each, consulted by the
+production code at exactly the points the real fault would strike:
 
 * ``maybe_nan(state, metrics, lo, hi)`` — called by the train loops after
-  each dispatch; poisons params + metrics with NaN once, when the armed
-  step falls in ``[lo, hi]`` (the divergence-guard recovery paths).
+  each dispatch; poisons params + metrics with NaN, when an armed step
+  falls in ``[lo, hi]`` (the divergence-guard recovery paths).  A list of
+  steps models a NaN *burst*: the poison re-strikes after each recovery,
+  driving the guard's escalation ladder.
 * ``maybe_crash_mid_save(step)`` — called by ``save_state`` after the
   checkpoint bytes are written but *before* the atomic finalize rename;
   raises :class:`SimulatedCrash`, leaving an unfinalized tmp directory
   behind exactly like a SIGKILL mid-save (the restore-fallback path).
-* :class:`FlakyDataset` — wraps any dataset so chosen indices raise for
-  the first N accesses (transient I/O) or always (corrupt item), driving
-  the loader's retry/quarantine path.
+* ``maybe_io_error(what)`` — called by ``save_state`` at the top of each
+  write *attempt*; raises ``OSError`` for the first ``io_error_saves``
+  attempts.  A count within the retry budget is absorbed by the bounded
+  backoff; a larger one surfaces as a diagnosed save failure.
+* ``at_step(lo, hi)`` — the step-boundary control faults, called by the
+  loops once per step/chunk: ``slow_step`` (sleep once — a transient
+  stall a sane watchdog timeout must tolerate), ``sigterm_at_step``
+  (self-delivered SIGTERM — deterministic preemption, including
+  one-host-of-many for the consensus tests), and ``hang`` (never return —
+  a wedged collective; only the hang watchdog gets the process out).
+* ``wrap_dataset(ds, role)`` — wraps a train dataset in
+  :class:`FlakyDataset` when the plan condemns items for that role,
+  driving the loader's retry/quarantine path from a subprocess.
+* :class:`FlakyDataset` — the in-process form: chosen indices raise for
+  the first N accesses (transient I/O) or always (corrupt item).
 
 All hooks are no-ops (one ``is None`` check) unless a plan is armed, so
 the production hot paths pay nothing.  Arm programmatically with
 :func:`arm`, or via the ``DWT_FAULT_PLAN`` env var (JSON, read once at
-first use) for subprocess tests.  Every fault fires at most once per arm:
-recovery paths must not re-trip on the state they just repaired.
+first use) for subprocess tests; the kinds compose — one plan may slow a
+step, fail a save twice, and then deliver SIGTERM.  Every fault fires at
+most once per arm (each element of a burst list counts once): recovery
+paths must not re-trip on the state they just repaired.
 """
 
 from __future__ import annotations
@@ -31,7 +49,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import signal
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 ENV_VAR = "DWT_FAULT_PLAN"
 
@@ -40,28 +60,194 @@ class SimulatedCrash(Exception):
     """Raised by an armed kill-mid-save hook (stands in for SIGKILL)."""
 
 
+def _as_step_list(
+    value: Any, field: str, minimum: int = 1
+) -> Optional[List[int]]:
+    """Normalize an int-or-list spec; reject bools/floats/duplicates and
+    values below ``minimum`` (global steps are 1-based, item indices
+    0-based — an out-of-range value can never fire, and a fault plan
+    that injects nothing proves nothing)."""
+    if value is None:
+        return None
+    items = value if isinstance(value, list) else [value]
+    steps: List[int] = []
+    for v in items:
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(
+                f"{ENV_VAR}: {field} must be an int step or list of int "
+                f"steps; got {v!r}"
+            )
+        if v < minimum:
+            raise ValueError(
+                f"{ENV_VAR}: {field} values must be >= {minimum} "
+                f"(got {v}) — a value that can never fire is a silent "
+                "no-op, not a fault"
+            )
+        steps.append(v)
+    if len(set(steps)) != len(steps):
+        raise ValueError(f"{ENV_VAR}: duplicate steps in {field}: {steps}")
+    return sorted(steps)
+
+
 @dataclasses.dataclass
 class FaultPlan:
     """One-shot fault schedule.  Fields default to "never fire"."""
 
     # Poison params/metrics with NaN after the train step with this
-    # (1-based) global step number completes.
-    nan_at_step: Optional[int] = None
+    # (1-based) global step number completes.  A list is a burst: each
+    # listed step fires once, so the poison re-strikes after recovery.
+    nan_at_step: Any = None
     # Raise SimulatedCrash inside save_state after the bytes are written
     # but before the finalize rename.  True = next save; int = the save
     # at that step.
     crash_in_save: Any = None
+    # Step-boundary control faults (see module docstring).
+    hang_at_step: Optional[int] = None
+    slow_step_at: Optional[int] = None
+    slow_step_s: float = 1.0
+    sigterm_at_step: Optional[int] = None
+    # Number of save-write ATTEMPTS that raise OSError (each bounded-
+    # backoff retry is one attempt, so 2 is absorbed, 99 is persistent).
+    io_error_saves: int = 0
+    # {"source": [idx, ...], "target": [...]} — items the loops' datasets
+    # report as corrupt (the loader quarantines them).
+    corrupt_items: Optional[Dict[str, List[int]]] = None
+
+    _FIELDS = (
+        "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
+        "slow_step_s", "sigterm_at_step", "io_error_saves", "corrupt_items",
+    )
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "FaultPlan":
+        """Build a validated plan from a parsed JSON object.
+
+        Silent no-ops are the worst failure mode of a fault plan — a test
+        that injects nothing proves nothing — so unknown kinds, bad
+        types, duplicate steps, and overlapping control faults all raise
+        instead of being dropped.
+        """
+        unknown = sorted(set(spec) - set(cls._FIELDS))
+        if unknown:
+            raise ValueError(
+                f"{ENV_VAR}: unknown fault kind(s) {unknown}; "
+                f"valid kinds: {list(cls._FIELDS)}"
+            )
+        nan = _as_step_list(spec.get("nan_at_step"), "nan_at_step")
+        if nan is not None and not isinstance(spec["nan_at_step"], list):
+            nan = nan[0]  # scalar in, scalar out (burst lists stay lists)
+
+        def _opt_int(field):
+            v = spec.get(field)
+            if v is None:
+                return None
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(
+                    f"{ENV_VAR}: {field} must be an int step; got {v!r}"
+                )
+            if v < 1:
+                raise ValueError(
+                    f"{ENV_VAR}: {field} must be a 1-based step >= 1; "
+                    f"got {v} (it would never fire)"
+                )
+            return v
+
+        hang = _opt_int("hang_at_step")
+        slow = _opt_int("slow_step_at")
+        sigterm = _opt_int("sigterm_at_step")
+        if hang is not None and sigterm is not None:
+            raise ValueError(
+                f"{ENV_VAR}: hang_at_step and sigterm_at_step cannot "
+                "compose in one plan — a hang ends the process's useful "
+                "life, and with steps_per_dispatch > 1 both can land on "
+                "the SAME chunk boundary where the hang silently "
+                "swallows the SIGTERM; pick one control fault per plan"
+            )
+        slow_s = spec.get("slow_step_s", 1.0)
+        if isinstance(slow_s, bool) or not isinstance(slow_s, (int, float)) \
+                or slow_s < 0:
+            raise ValueError(
+                f"{ENV_VAR}: slow_step_s must be a non-negative number; "
+                f"got {slow_s!r}"
+            )
+        if "slow_step_s" in spec and slow is None:
+            raise ValueError(
+                f"{ENV_VAR}: slow_step_s without slow_step_at arms "
+                "nothing — name the step the stall should hit"
+            )
+        io_saves = spec.get("io_error_saves", 0)
+        if isinstance(io_saves, bool) or not isinstance(io_saves, int) \
+                or io_saves < 0:
+            raise ValueError(
+                f"{ENV_VAR}: io_error_saves must be a non-negative int; "
+                f"got {io_saves!r}"
+            )
+        crash = spec.get("crash_in_save")
+        if crash is not None and crash is not True and (
+                isinstance(crash, bool) or not isinstance(crash, int)
+                or crash < 1):
+            raise ValueError(
+                f"{ENV_VAR}: crash_in_save must be true (next save) or an "
+                f"int step >= 1; got {crash!r}"
+            )
+        corrupt = spec.get("corrupt_items")
+        if corrupt is not None:
+            if not isinstance(corrupt, dict):
+                raise ValueError(
+                    f"{ENV_VAR}: corrupt_items must map a stream role to a "
+                    f"list of item indices; got {corrupt!r}"
+                )
+            normalized = {}
+            for role, ids in corrupt.items():
+                if role not in ("source", "target"):
+                    raise ValueError(
+                        f"{ENV_VAR}: corrupt_items role must be 'source' or "
+                        f"'target'; got {role!r}"
+                    )
+                # Keep the NORMALIZED list: a scalar spec must arm, not
+                # crash (or silently no-op) at wrap_dataset.  Item
+                # indices are 0-based (unlike steps).
+                normalized[role] = _as_step_list(
+                    ids, f"corrupt_items[{role!r}]", minimum=0
+                )
+            corrupt = normalized
+        return cls(
+            nan_at_step=nan,
+            crash_in_save=crash,
+            hang_at_step=hang,
+            slow_step_at=slow,
+            slow_step_s=float(slow_s),
+            sigterm_at_step=sigterm,
+            io_error_saves=io_saves,
+            corrupt_items=corrupt,
+        )
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
         raw = os.environ.get(ENV_VAR)
         if not raw:
             return None
-        spec = json.loads(raw)
-        return cls(
-            nan_at_step=spec.get("nan_at_step"),
-            crash_in_save=spec.get("crash_in_save"),
-        )
+
+        def _no_duplicates(pairs):
+            keys = [k for k, _ in pairs]
+            dupes = sorted({k for k in keys if keys.count(k) > 1})
+            if dupes:
+                raise ValueError(
+                    f"{ENV_VAR}: duplicate fault kind(s) {dupes} — the "
+                    "second spec would silently shadow the first"
+                )
+            return dict(pairs)
+
+        try:
+            spec = json.loads(raw, object_pairs_hook=_no_duplicates)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{ENV_VAR} is not valid JSON: {e}") from e
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"{ENV_VAR} must be a JSON object of fault kinds; "
+                f"got {type(spec).__name__}"
+            )
+        return cls.from_spec(spec)
 
 
 _plan: Optional[FaultPlan] = None
@@ -104,8 +290,9 @@ def _poison_tree(tree: Any) -> Any:
 
 
 def maybe_nan(state, metrics, lo: int, hi: Optional[int] = None) -> Tuple[Any, Any]:
-    """Poison ``(state.params, metrics)`` with NaN if the armed step is in
-    ``[lo, hi]`` (both inclusive; ``hi`` defaults to ``lo``).  Fires once.
+    """Poison ``(state.params, metrics)`` with NaN if an armed step is in
+    ``[lo, hi]`` (both inclusive; ``hi`` defaults to ``lo``).  Each armed
+    step fires once; a burst list re-strikes after every recovery.
 
     The chunked (``steps_per_dispatch``) path passes the whole dispatched
     step range, since the host only regains control at chunk boundaries —
@@ -115,9 +302,13 @@ def maybe_nan(state, metrics, lo: int, hi: Optional[int] = None) -> Tuple[Any, A
     if plan is None or plan.nan_at_step is None:
         return state, metrics
     hi = lo if hi is None else hi
-    if not (lo <= plan.nan_at_step <= hi):
+    steps = (plan.nan_at_step if isinstance(plan.nan_at_step, list)
+             else [plan.nan_at_step])
+    hit = [s for s in steps if lo <= s <= hi]
+    if not hit:
         return state, metrics
-    plan.nan_at_step = None  # one-shot
+    remaining = [s for s in steps if s not in hit]  # each element one-shot
+    plan.nan_at_step = remaining or None
     state = state.replace(params=_poison_tree(state.params))
     return state, _poison_tree(dict(metrics))
 
@@ -130,6 +321,62 @@ def maybe_crash_mid_save(step: int) -> None:
     if plan.crash_in_save is True or int(plan.crash_in_save) == int(step):
         plan.crash_in_save = None  # one-shot
         raise SimulatedCrash(f"injected crash during checkpoint save @{step}")
+
+
+def maybe_io_error(what: str = "save") -> None:
+    """Raise ``OSError`` for the first ``io_error_saves`` attempts.
+
+    Called at the top of each checkpoint write attempt (inside the
+    bounded-backoff retry wrapper), so a small count models a transient
+    mount hiccup the retries absorb, and a large one a dead filesystem
+    the caller must diagnose.
+    """
+    plan = current()
+    if plan is None or not plan.io_error_saves:
+        return
+    plan.io_error_saves -= 1
+    raise OSError(f"injected I/O error during checkpoint {what}")
+
+
+def at_step(lo: int, hi: Optional[int] = None) -> None:
+    """Step-boundary control faults: slow, then SIGTERM, then hang.
+
+    Ordering matters for composed plans at one boundary: a slow step must
+    finish (the watchdog tolerates it) before the terminal faults.  Hang
+    and SIGTERM never share a plan (``from_spec`` rejects the combination
+    — chunked dispatch could land both on one boundary, where the hang
+    would silently swallow the SIGTERM); the hang never returns — only
+    the watchdog (or the scheduler's SIGKILL) ends the process, exactly
+    like a wedged collective.
+    """
+    plan = current()
+    if plan is None:
+        return
+    hi = lo if hi is None else hi
+    if plan.slow_step_at is not None and lo <= plan.slow_step_at <= hi:
+        plan.slow_step_at = None  # one-shot
+        time.sleep(plan.slow_step_s)
+    if plan.sigterm_at_step is not None and lo <= plan.sigterm_at_step <= hi:
+        plan.sigterm_at_step = None  # one-shot
+        os.kill(os.getpid(), signal.SIGTERM)
+    if plan.hang_at_step is not None and lo <= plan.hang_at_step <= hi:
+        plan.hang_at_step = None
+        while True:  # a wedged collective does not poll flags either
+            time.sleep(60.0)
+
+
+def wrap_dataset(dataset: Any, role: str) -> Any:
+    """Wrap ``dataset`` in :class:`FlakyDataset` when the plan condemns
+    items for ``role`` ('source'/'target'); pass-through otherwise."""
+    plan = current()
+    if plan is None or not plan.corrupt_items:
+        return dataset
+    ids = plan.corrupt_items.get(role)
+    if isinstance(ids, int):  # programmatic arm() may pass a bare index
+        ids = [ids]
+    if not ids:
+        return dataset
+    return FlakyDataset(dataset, corrupt=tuple(int(i) for i in ids))
 
 
 class FlakyDataset:

@@ -50,13 +50,26 @@ from dwt_tpu.data import (
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
 from dwt_tpu.resilience import (
     AsyncCheckpointer,
+    Coordinator,
     DivergenceError,
     DivergenceGuard,
+    HangWatchdog,
     PreemptionHandler,
     RollbackRequest,
     inject,
 )
-from dwt_tpu.train.optim import adam_l2, multistep_schedule, officehome_tx
+from dwt_tpu.resilience.coord import (
+    EVENT_HALT,
+    EVENT_NONE,
+    EVENT_RECOVERED,
+    EVENT_ROLLBACK,
+)
+from dwt_tpu.train.optim import (
+    adam_l2,
+    multistep_schedule,
+    officehome_tx,
+    with_lr_backoff,
+)
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
     make_digits_train_step,
@@ -95,6 +108,19 @@ def _synthetic_classification_arrays(
     return images, labels.astype(np.int64)
 
 
+def _distributed_initialized() -> bool:
+    """Version-portable ``jax.distributed.is_initialized`` (the public
+    predicate only exists in newer jax; older releases expose the client
+    through the private global state — still backend-init-safe to read)."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src.distributed import global_state
+    except ImportError:  # pragma: no cover - future jax will have the public API
+        return False
+    return global_state.client is not None
+
+
 def _maybe_init_distributed(cfg) -> None:
     """Multi-host bring-up when requested (``--distributed``).
 
@@ -112,7 +138,7 @@ def _maybe_init_distributed(cfg) -> None:
     # Must not touch any backend-initializing API (jax.process_count,
     # jax.devices, ...) before initialize() — probing would flip
     # backends_are_initialized and make initialize() raise.
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return
     from dwt_tpu.parallel import initialize_distributed
 
@@ -318,14 +344,106 @@ def _params_digest(state: TrainState) -> float:
 
 def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
     policy = getattr(cfg, "guard_policy", "none") or "none"
+    backoff = getattr(cfg, "guard_lr_backoff", 0.0) or 0.0
     if policy == "none":
+        if backoff:
+            # A silently-ignored rung is worse than an error: the user
+            # asked for divergence handling and would get none.
+            raise ValueError(
+                "--guard_lr_backoff needs an active guard (the ladder "
+                "escalates INTO --guard_policy); pass --guard_policy "
+                "halt|skip_step|rollback"
+            )
         return None
     return DivergenceGuard(
         policy,
         getattr(cfg, "guard_interval", 50),
         logger,
         max_rollbacks=getattr(cfg, "guard_max_rollbacks", 3),
+        lr_backoff=backoff,
+        backoff_recovery=getattr(cfg, "guard_backoff_recovery", 3),
     )
+
+
+class _StepBoundary:
+    """Everything the loops must do once per step/chunk boundary, fused
+    into one call: the step-indexed control-fault hooks, the watchdog
+    heartbeat, the amortized guard check, and — on multi-host runs — the
+    consensus that turns any-host events into an all-host decision.
+
+    Returns ``(state, stop)`` (the chunked ``on_steps`` contract); raises
+    ``RollbackRequest``/``DivergenceError`` for the loops' existing
+    handlers only after every host has agreed to the same fate, so no
+    host is left alone inside a collective.  ``stop`` is sticky
+    (``self.stop``): on multi-host it may come from ANOTHER host's
+    SIGTERM, so the loops consult it — not ``preempt.should_stop`` —
+    after leaving the step loop.
+    """
+
+    def __init__(self, guard, preempt, coord, watchdog):
+        self.guard = guard
+        self.preempt = preempt
+        self.coord = coord
+        self.watchdog = watchdog
+        self.stop = False
+
+    def __call__(self, state, metrics, n_steps: int, gstep: int):
+        self.watchdog.heartbeat()
+        # Control faults fire between the heartbeat and the guard so an
+        # injected hang is measured from a fresh beat and an injected
+        # SIGTERM is visible to this very boundary's stop flag.
+        inject.at_step(gstep - n_steps + 1, gstep)
+        event = None
+        code = EVENT_NONE
+        if self.guard is not None:
+            recoveries_before = self.guard.recoveries
+            try:
+                state = self.guard.step(state, metrics, n_steps, gstep)
+                if self.guard.recoveries != recoveries_before:
+                    # lr_backoff/skip_step fired: no exception, but the
+                    # other hosts must take the same rung.
+                    code = EVENT_RECOVERED
+            except RollbackRequest as e:
+                event, code = e, EVENT_ROLLBACK
+            except DivergenceError as e:
+                event, code = e, EVENT_HALT
+        if self.coord.enabled:
+            decision = self.coord.decide(
+                stop=self.preempt.should_stop,
+                event=code,
+                rollback_step=(
+                    event.step if isinstance(event, RollbackRequest) else -1
+                ),
+            )
+            self.stop = self.stop or decision.stop
+            if event is not None:
+                raise event  # every host now knows; act on the local event
+            if decision.event > code:
+                # A remote guard outranked this host's view (its fault
+                # preceded the collective, e.g. a host-local data NaN, or
+                # its ladder escalated further): mirror the remote rung so
+                # the replicated state stays identical on every process.
+                if decision.event == EVENT_ROLLBACK and self.guard is not None:
+                    # Keep the rollback budget and the re-seed stride in
+                    # lockstep with the host that fired: every process
+                    # must derive the SAME post-rollback shuffle seed.
+                    self.guard.rollbacks += 1
+                    raise RollbackRequest(
+                        decision.rollback_step,
+                        "divergence detected on another host",
+                    )
+                if decision.event == EVENT_RECOVERED and self.guard is not None:
+                    # Same in-memory rung the remote host took (snapshots
+                    # are replicated, so the recovered states agree); may
+                    # itself escalate — consistently, ladders are in lock.
+                    state = self.guard.mirror_recovery(state, gstep)
+                    return state, self.stop
+                raise DivergenceError("divergence detected on another host")
+            return state, self.stop
+        if event is not None:
+            raise event
+        self.stop = self.stop or self.preempt.should_stop
+        return state, self.stop
 
 
 # Seed stride between rollback attempts: a prime far from any plausible
@@ -404,6 +522,15 @@ class _CkptPipeline:
             self._acp.close(raise_errors=raise_errors)
 
 
+def _keep_kwargs(cfg) -> dict:
+    """``save_state`` kwargs for MAIN-dir saves: ``--keep_ckpts N`` prunes
+    to the newest N steps there.  Anchors and best_* artifacts live in
+    their own directories and never receive a ``keep`` — anchors exist
+    precisely to survive pruning."""
+    keep = getattr(cfg, "keep_ckpts", 0) or 0
+    return {"keep": keep} if keep > 0 else {}
+
+
 def _ranked_checkpoints(ckpt_dir: str):
     """Every valid checkpoint across the main dir and its anchors as
     ``(step, is_main, source, dir)``, newest step first (ties — a step
@@ -430,24 +557,48 @@ def _restore_newest(ckpt_dir: str, template, ranked=None):
     """
     if ranked is None:
         ranked = _ranked_checkpoints(ckpt_dir)
+    errors = []
     for s, _, src, d in ranked:
         try:
             return restore_state(d, template, step=s), src
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            errors.append(f"{src} step {s}: {e}")
             continue
+    if errors:
+        # Every candidate failed — say WHY before the caller dies with a
+        # bare "no restorable checkpoints": an opt-state STRUCTURE
+        # mismatch (e.g. artifacts written by an older revision) needs a
+        # very different operator response than torn bytes.
+        log.warning(
+            "no checkpoint under %s restored; per-candidate errors: %s",
+            ckpt_dir, " | ".join(errors[:4]),
+        )
     return None
 
 
-def _rollback_state(cfg, logger, guard: DivergenceGuard, template, failed_step):
+def _rollback_state(
+    cfg, logger, guard: DivergenceGuard, template, failed_step, coord=None
+):
     """Recovery state for a ``rollback`` policy hit: the newest valid
     on-disk checkpoint (anchors included), else the guard's last
     in-memory good state.  Callers flush the async checkpoint pipeline
     BEFORE calling, so the in-flight save is on disk and the writer
     cannot race this directory walk.
+
+    Multi-host: hosts first agree on the restore target — the min over
+    each host's newest valid step (the newest step EVERY host can see;
+    a finalize rename may be visible on one host a beat before another
+    on networked storage) — so all processes restore the SAME step and
+    re-enter the collective program in lockstep.
     """
     restored, source = None, "checkpoint"
     if cfg.ckpt_dir:
-        out = _restore_newest(cfg.ckpt_dir, template)
+        ranked = _ranked_checkpoints(cfg.ckpt_dir)
+        if coord is not None and coord.enabled:
+            newest = ranked[0][0] if ranked else -1
+            agreed = coord.agree_step(newest)
+            ranked = [r for r in ranked if r[0] <= agreed]
+        out = _restore_newest(cfg.ckpt_dir, template, ranked)
         if out is not None:
             restored, source = out
     if restored is None:
@@ -457,6 +608,15 @@ def _rollback_state(cfg, logger, guard: DivergenceGuard, template, failed_step):
             f"divergence at step {failed_step} with nothing to roll back "
             "to (no valid checkpoint, no in-memory snapshot)"
         )
+    if coord is not None and coord.enabled:
+        # The agreement above is best-effort (a pruned/torn artifact can
+        # still force one host onto an older candidate or the memory
+        # snapshot): verify every process actually landed on the SAME
+        # step, and halt loudly rather than train forked replicas.
+        coord.assert_same(int(restored.step), "rollback restore step")
+    # The saved scale predates the divergence; if the ladder is currently
+    # backed off, the replayed segment must train gently too.
+    restored = guard.reapply_backoff(restored)
     guard.prime(restored)  # next divergence measures from THIS state
     logger.log(
         "rollback",
@@ -593,6 +753,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         )
 
     source_ds, target_ds, target_test_ds = _digits_datasets(cfg)
+    # Fault hook: an armed corrupt_items plan condemns train items so the
+    # loader's retry/quarantine path is drivable from subprocess tests.
+    source_ds = inject.wrap_dataset(source_ds, "source")
+    target_ds = inject.wrap_dataset(target_ds, "target")
     bs = cfg.source_batch_size  # GLOBAL per-domain batch (reference value)
     local_bs, shard = _multihost_data_split(cfg, bs)
     steps_per_epoch = min(len(source_ds), len(target_ds)) // bs
@@ -604,7 +768,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     schedule = multistep_schedule(
         cfg.lr, cfg.lr_milestones, cfg.lr_gamma, scale=steps_per_epoch
     )
-    tx = adam_l2(schedule, cfg.weight_decay)
+    # Backoff wrap is unconditional (inert at 1.0): a conditional wrap
+    # would fork the opt-state structure and strand checkpoints across
+    # guard configurations.
+    tx = with_lr_backoff(adam_l2(schedule, cfg.weight_decay))
 
     def build_model(axis_name=None):
         return LeNetDWT(
@@ -670,6 +837,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     if guard:
         guard.prime(state)
     ckpt = _CkptPipeline(cfg)
+    coord = Coordinator()  # multi-host consensus; single-process: inert
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -677,11 +845,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     epoch = start_epoch
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
     gstep = int(state.step)  # host-side global step count (guard/injection)
-    with contextlib.ExitStack() as _cleanup, PreemptionHandler(logger) as preempt:
+    with contextlib.ExitStack() as _cleanup, PreemptionHandler(
+        logger
+    ) as preempt, HangWatchdog(
+        cfg.watchdog_timeout, cfg.ckpt_dir, logger
+    ) as wd:
         # Abnormal-exit rendezvous: join (don't abandon) a live writer
         # thread; errors were already logged and must not mask the
         # original exception.  Normal paths flush explicitly first.
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
+        boundary = _StepBoundary(guard, preempt, coord, wd)
         while epoch < cfg.epochs:
             source_iter = batch_iterator(
                 source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
@@ -725,9 +898,8 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                                 cls_loss=metrics["cls_loss"],
                                 entropy_loss=metrics["entropy_loss"],
                             )
-                        if guard:
-                            state = guard.step(state, metrics, 1, gstep)
-                        if preempt.should_stop:
+                        state, stop = boundary(state, metrics, 1, gstep)
+                        if stop:
                             break
                 else:
                     # k steps per dispatch: scan over stacked batches;
@@ -757,9 +929,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                                     entropy_loss=ms["entropy_loss"][jj],
                                 )
                         pos += n
-                        if guard:
-                            st = guard.step(st, ms, n, gstep)
-                        return st, preempt.should_stop
+                        return boundary(st, ms, n, gstep)
 
                     batches = prefetch_to_device(
                         _chunk_stream(epoch_batches(), k_dispatch),
@@ -777,8 +947,18 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 # (transient disk-full, already logged) must not abort the
                 # recovery path when an older valid checkpoint or the
                 # in-memory snapshot could still save the run.
-                ckpt.close(raise_errors=False)
-                state = _rollback_state(cfg, logger, guard, state, rb.step)
+                with wd.suspended():  # writer join blocks on in-flight I/O
+                    ckpt.close(raise_errors=False)
+                # UNMASKED on purpose: _rollback_state's consensus
+                # collectives (agree_step/assert_same) must stay
+                # watchable — a peer dying mid-rollback would otherwise
+                # hang here forever with the watchdog blinded.  The
+                # timeout budgets a restore, exactly like the unmasked
+                # restore on the startup resume path.
+                state = _rollback_state(
+                    cfg, logger, guard, state, rb.step, coord
+                )
+                wd.heartbeat()
                 gstep = int(state.step)
                 epoch = gstep // steps_per_epoch
                 seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
@@ -794,24 +974,32 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                     batches.close()
                 source_iter.close()
                 target_iter.close()
-            if preempt.should_stop:
+            if boundary.stop:
                 # Preemption grace windows are short: save and get out —
-                # skip the per-epoch eval, return with exit code 0.  The
+                # skip the per-epoch eval, return with exit code 0.  On
+                # multi-host the stop decision is CONSENSUS (it may have
+                # been another host's SIGTERM), so every process reaches
+                # this coordinated save together at the same step.  The
                 # flush rendezvous makes the final checkpoint durable
                 # before the process exits.  Clear any STALE writer error
                 # first (already logged): an old failed periodic save must
                 # not block the final save this exit-0 contract promises —
                 # only the final save's OWN failure may surface here.
                 if cfg.ckpt_dir:
-                    ckpt.close(raise_errors=False)
-                    ckpt.save(cfg.ckpt_dir, int(state.step), state)
-                    ckpt.flush()
+                    with wd.suspended():  # final save must not be killed
+                        ckpt.close(raise_errors=False)
+                        ckpt.save(
+                            cfg.ckpt_dir, int(state.step), state,
+                            **_keep_kwargs(cfg),
+                        )
+                        ckpt.flush()
                 logger.log("preempt", int(state.step), epoch=epoch, sync=True)
                 return acc
             result = _evaluate(
                 eval_step, state, target_test_ds, cfg.test_batch_size,
                 num_workers=cfg.num_workers,
             )
+            wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), epoch=epoch, **result)
             targets = []
@@ -819,17 +1007,25 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 (epoch + 1) % cfg.ckpt_every_epochs == 0
                 or epoch == cfg.epochs - 1
             ):
-                targets.append((cfg.ckpt_dir, {}))
+                targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
             if cfg.ckpt_dir and cfg.anchor_every and (
                 (epoch + 1) % cfg.anchor_every == 0
             ):
                 targets.append((_anchor_dir(cfg.ckpt_dir), {}))
             if targets:
-                ckpt.save_multi(targets, int(state.step), state)
+                # A synchronous save (--no-async_ckpt, or the multi-host
+                # downgrade) can legitimately block past the watchdog
+                # timeout — masked, or the watchdog would kill the same
+                # healthy save on every relaunch (livelock).
+                with wd.suspended():
+                    ckpt.save_multi(targets, int(state.step), state)
             epoch += 1
         # Final rendezvous: surface any writer failure while the run can
-        # still exit nonzero, and leave no dangling writer thread.
-        ckpt.flush()
+        # still exit nonzero, and leave no dangling writer thread.  The
+        # join blocks on the in-flight write — masked like every other
+        # blocking save section.
+        with wd.suspended():
+            ckpt.flush()
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
     return acc
 
@@ -901,6 +1097,9 @@ def run_officehome(
     _maybe_init_distributed(cfg)
 
     source_ds, target_ds, test_ds = _officehome_datasets(cfg)
+    # Fault hook: see run_digits — drives retry/quarantine from subprocesses.
+    source_ds = inject.wrap_dataset(source_ds, "source")
+    target_ds = inject.wrap_dataset(target_ds, "target")
     bs = cfg.source_batch_size  # target loader uses source bs too (:565)
     local_bs, shard = _multihost_data_split(cfg, bs)
 
@@ -995,6 +1194,7 @@ def run_officehome(
 
     acc = 0.0
     ckpt = _CkptPipeline(cfg)
+    coord = Coordinator()  # multi-host consensus; single-process: inert
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -1014,6 +1214,7 @@ def run_officehome(
                 eval_step, state, test_ds, cfg.test_batch_size,
                 num_workers=cfg.num_workers,
             )
+            wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), iter=it, **result)
             if cfg.ckpt_dir and acc > best_acc:
@@ -1026,25 +1227,31 @@ def run_officehome(
                 # error) must not update the record either, or a resume
                 # would seed best_acc above every real checkpoint and
                 # model_best would never update again.
-                best_path = ckpt.save_sync(
-                    os.path.join(cfg.ckpt_dir, f"best_gr_{cfg.group_size}"),
-                    int(state.step),
-                    state,
-                    keep=1,
-                )
+                with wd.suspended():  # blocking by design (see above)
+                    best_path = ckpt.save_sync(
+                        os.path.join(
+                            cfg.ckpt_dir, f"best_gr_{cfg.group_size}"
+                        ),
+                        int(state.step),
+                        state,
+                        keep=1,
+                    )
                 if best_path is not None:
                     best_acc = acc
                     _write_best_record(cfg.ckpt_dir, acc, int(state.step))
                     logger.log("best", int(state.step), accuracy=acc)
         targets = []
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
-            targets.append((cfg.ckpt_dir, {}))
+            targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
         if cfg.ckpt_dir and cfg.anchor_every and (
             (it + 1) % cfg.anchor_every == 0
         ):
             targets.append((_anchor_dir(cfg.ckpt_dir), {}))
         if targets:
-            ckpt.save_multi(targets, int(state.step), state)
+            # Sync saves may block past the watchdog timeout (see
+            # run_digits) — masked, not raced.
+            with wd.suspended():
+                ckpt.save_multi(targets, int(state.step), state)
 
     # Overlap host-side decode/augmentation with device compute (the aug
     # pipeline is the expensive host stage for OfficeHome); the per-item
@@ -1054,9 +1261,14 @@ def run_officehome(
     if guard:
         guard.prime(state)
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
-    with contextlib.ExitStack() as _cleanup, PreemptionHandler(logger) as preempt:
+    with contextlib.ExitStack() as _cleanup, PreemptionHandler(
+        logger
+    ) as preempt, HangWatchdog(
+        cfg.watchdog_timeout, cfg.ckpt_dir, logger
+    ) as wd:
         # Abnormal-exit rendezvous for the async writer (see run_digits).
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
+        boundary = _StepBoundary(guard, preempt, coord, wd)
         # Rollback retry loop: each attempt builds fresh (re-seeded)
         # streams and trains from the current state; a RollbackRequest
         # restores the newest valid checkpoint and starts a new attempt.
@@ -1112,12 +1324,11 @@ def run_officehome(
                                 it, step0 + it + 1,
                                 metrics["cls_loss"], metrics["mec_loss"],
                             )
-                        if guard:
-                            state = guard.step(
-                                state, metrics, 1, step0 + it + 1
-                            )
+                        state, stop = boundary(
+                            state, metrics, 1, step0 + it + 1
+                        )
                         _boundary_actions(it)
-                        if preempt.should_stop:
+                        if stop:
                             break
                 else:
                     # Checkpoint boundaries only matter when checkpointing
@@ -1146,11 +1357,10 @@ def run_officehome(
                                     ms["mec_loss"][j],
                                 )
                         it += n
-                        if guard:
-                            state = guard.step(state, ms, n, step0 + it)
+                        state, stop = boundary(state, ms, n, step0 + it)
                         # _boundary_actions evaluates/saves the live state
                         _boundary_actions(it - 1)
-                        return state, preempt.should_stop
+                        return state, stop
 
                     batches = prefetch_to_device(
                         _chunk_stream(
@@ -1166,8 +1376,14 @@ def run_officehome(
             except RollbackRequest as rb:
                 # Non-raising rendezvous before restore (see run_digits
                 # rollback: a stale writer error must not abort recovery).
-                ckpt.close(raise_errors=False)
-                state = _rollback_state(cfg, logger, guard, state, rb.step)
+                with wd.suspended():  # writer join blocks on in-flight I/O
+                    ckpt.close(raise_errors=False)
+                # Unmasked: the rollback consensus collectives must stay
+                # watchable (see run_digits).
+                state = _rollback_state(
+                    cfg, logger, guard, state, rb.step, coord
+                )
+                wd.heartbeat()
                 start_iter = int(state.step)
                 seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
                 continue
@@ -1185,20 +1401,29 @@ def run_officehome(
                 target_stream.close()
             break
 
-        if preempt.should_stop:
+        if boundary.stop:
             # Save and get out inside the grace window; skip the
-            # stat-collection protocol (a resumed run redoes it).  Flush:
-            # the checkpoint must be durable before the exit-0 return.
-            # Stale writer errors are cleared first (see run_digits).
+            # stat-collection protocol (a resumed run redoes it).  On
+            # multi-host the stop is the CONSENSUS decision — possibly
+            # another host's SIGTERM — so every process saves the same
+            # step together.  Flush: the checkpoint must be durable
+            # before the exit-0 return.  Stale writer errors are cleared
+            # first (see run_digits).
             if cfg.ckpt_dir:
-                ckpt.close(raise_errors=False)
-                ckpt.save(cfg.ckpt_dir, int(state.step), state)
-                ckpt.flush()
+                with wd.suspended():  # final save must not be killed
+                    ckpt.close(raise_errors=False)
+                    ckpt.save(
+                        cfg.ckpt_dir, int(state.step), state,
+                        **_keep_kwargs(cfg),
+                    )
+                    ckpt.flush()
             logger.log("preempt", int(state.step), sync=True)
             return acc
         # Training done: surface any in-flight writer failure before the
-        # stat-collection protocol spends more device time.
-        ckpt.flush()
+        # stat-collection protocol spends more device time.  Masked: the
+        # join blocks on the in-flight write (see run_digits).
+        with wd.suspended():
+            ckpt.flush()
 
     # Post-training protocol: N gradient-free train-mode passes over the
     # target TEST set with tripled data to re-estimate target stats
@@ -1226,6 +1451,6 @@ def run_officehome(
     if cfg.ckpt_dir:
         # Post-stat-collection state is the run's artifact; save + flush
         # (effectively synchronous — nothing overlaps a final save).
-        ckpt.save(cfg.ckpt_dir, int(state.step), state)
+        ckpt.save(cfg.ckpt_dir, int(state.step), state, **_keep_kwargs(cfg))
         ckpt.flush()
     return acc

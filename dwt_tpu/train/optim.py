@@ -7,9 +7,94 @@ decoupled decay — the optax chains below preserve that ordering.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, NamedTuple, Optional, Sequence
 
 import optax
+
+
+class BackoffScaleState(NamedTuple):
+    """Host-settable global update scale (the guard's lr-backoff rung)."""
+
+    scale: Any  # f32 scalar jax.Array; 1.0 = no backoff
+
+
+def scale_by_backoff() -> optax.GradientTransformation:
+    """Multiply the final updates by a state-carried scalar.
+
+    The divergence guard's first escalation rung reduces the effective
+    learning rate WITHOUT rebuilding/recompiling the optimizer: the scale
+    lives in the opt state (same pytree structure either way, so jit
+    caches and checkpoints are unaffected) and the host flips it between
+    steps via :func:`set_backoff_scale`.  At the default 1.0 the multiply
+    fuses into the update computation for free.
+    """
+
+    def init_fn(params):
+        del params
+        import jax.numpy as jnp
+
+        return BackoffScaleState(scale=jnp.ones((), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        del params
+        import jax
+
+        updates = jax.tree.map(
+            lambda u: u * state.scale.astype(u.dtype), updates
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def with_lr_backoff(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Chain ``tx`` with the injectable backoff scale (always last, so the
+    scale applies to the fully-formed update, lr included)."""
+    return optax.chain(tx, scale_by_backoff())
+
+
+def _map_backoff_states(opt_state, fn):
+    """Rebuild ``opt_state`` with ``fn`` applied to every BackoffScaleState.
+
+    Walks only the container spine (tuples/namedtuples/lists/dicts) —
+    array leaves pass through untouched, so this is cheap host-side
+    plumbing, not a tree.map over parameters.
+    """
+    if isinstance(opt_state, BackoffScaleState):
+        return fn(opt_state)
+    if isinstance(opt_state, tuple):
+        mapped = [_map_backoff_states(s, fn) for s in opt_state]
+        if hasattr(opt_state, "_fields"):  # namedtuple (optax states)
+            return type(opt_state)(*mapped)
+        return tuple(mapped)
+    if isinstance(opt_state, list):
+        return [_map_backoff_states(s, fn) for s in opt_state]
+    if isinstance(opt_state, dict):
+        return {k: _map_backoff_states(v, fn) for k, v in opt_state.items()}
+    return opt_state
+
+
+def has_backoff(opt_state) -> bool:
+    found = []
+    _map_backoff_states(opt_state, lambda s: (found.append(s), s)[1])
+    return bool(found)
+
+
+def get_backoff_scale(opt_state) -> Optional[float]:
+    """Current scale (host float), or None when ``tx`` was never wrapped."""
+    found = []
+    _map_backoff_states(opt_state, lambda s: (found.append(s), s)[1])
+    return float(found[0].scale) if found else None
+
+
+def set_backoff_scale(opt_state, scale: float):
+    """A copy of ``opt_state`` with every backoff scale set to ``scale``."""
+    import jax.numpy as jnp
+
+    value = jnp.asarray(scale, jnp.float32)
+    return _map_backoff_states(
+        opt_state, lambda s: BackoffScaleState(scale=value)
+    )
 
 
 def multistep_schedule(
@@ -92,11 +177,15 @@ def officehome_tx(cfg) -> optax.GradientTransformation:
     by ``run_officehome`` and ``dwt-convert``: both must produce the same
     opt-state pytree STRUCTURE or converted artifacts stop being
     restorable by the loop (scheduled lrs carry ScaleByScheduleState;
-    constants do not)."""
+    constants do not).  Wrapped with the guard's injectable backoff scale
+    unconditionally — at 1.0 it is inert, and a conditional wrap would
+    fork the opt-state structure between runs with and without
+    ``--guard_lr_backoff`` (converted artifacts would only restore under
+    the matching flag)."""
     head_lr = multistep_schedule(cfg.lr, cfg.lr_milestones, cfg.lr_gamma)
     backbone_lr = multistep_schedule(
         cfg.lr * cfg.backbone_lr_scale, cfg.lr_milestones, cfg.lr_gamma
     )
-    return sgd_two_group(
-        head_lr, backbone_lr, cfg.sgd_momentum, cfg.weight_decay
+    return with_lr_backoff(
+        sgd_two_group(head_lr, backbone_lr, cfg.sgd_momentum, cfg.weight_decay)
     )

@@ -66,11 +66,22 @@ def _mean_grads_if(grads: Any, axis_name: Optional[AxisName]) -> Any:
     size yields exactly ``∂((1/R)Σ_r loss_r)/∂θ``, the single-device
     global-batch gradient (SURVEY §4.4 invariant) — verified to float
     tolerance by ``tests/test_parallel.py``.
+
+    Older jax (the 0.4.x line, ``check_rep`` era — no varying-axis
+    tracking) does NOT insert that transpose psum: ``grads`` arrive
+    per-replica and need an explicit ``pmean`` — which also makes them
+    statically-inferable replicated, satisfying ``check_rep`` for the
+    replicated ``out_specs``.  ``lax.axis_size`` only exists in the new
+    era, so its presence is the capability probe.  Both branches produce
+    the identical global-mean gradient; the same parity tests verify
+    whichever branch the installed jax takes.
     """
     if axis_name is None:
         return grads
-    size = lax.axis_size(axis_name)
-    return jax.tree.map(lambda g: g / size, grads)
+    if hasattr(lax, "axis_size"):  # varying-axis-tracking era: see above
+        size = lax.axis_size(axis_name)
+        return jax.tree.map(lambda g: g / size, grads)
+    return lax.pmean(grads, axis_name)
 
 
 def make_digits_train_step(

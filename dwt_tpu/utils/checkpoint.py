@@ -231,6 +231,10 @@ def save_state(
         shutil.rmtree(tmp)
 
     def _write():
+        # Fault hook: one injected OSError per write ATTEMPT — inside the
+        # retry wrapper, so a transient count is absorbed by the backoff
+        # and a persistent one surfaces like a dead filesystem would.
+        inject.maybe_io_error(f"save @{step}")
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(tmp, state, force=True)
 

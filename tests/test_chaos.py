@@ -1,0 +1,431 @@
+"""Chaos matrix: every injected fault ends the run in one of exactly two
+states — exit 0 with a resumable newest-valid checkpoint, or a clean
+diagnosed halt (nonzero exit + written evidence) — NEVER a hang and never
+a torn state a resume would trust.
+
+Each case spawns the digits trainer as a real subprocess with a
+``DWT_FAULT_PLAN`` armed in its environment (dwt_tpu/resilience/inject.py)
+and asserts the contract from outside, the way a scheduler would see it.
+The matrix (all single-process cases plus the 2-process consensus cases)
+is slow-marked; one composed-fault smoke stays in tier-1.
+
+Also here: the strict ``FaultPlan`` spec parsing tests — a fault plan
+that silently injects nothing proves nothing, so bad/duplicate/
+overlapping specs must raise, not no-op.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+from test_distributed import _free_port
+
+from dwt_tpu.resilience import WATCHDOG_EXIT_CODE, inject
+from dwt_tpu.resilience.inject import FaultPlan
+from dwt_tpu.utils.checkpoint import is_valid_checkpoint, latest_step, valid_steps
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Digits synthetic config every chaos case runs: 4 steps/epoch, periodic
+# save every epoch.  Runs that must end by fault use epochs=500 (they
+# never finish naturally inside the subprocess timeout — ending any other
+# way than the expected one fails the case); runs that must COMPLETE
+# override epochs.
+_BASE_ARGS = (
+    "--synthetic", "--synthetic_size", "32",
+    "--source_batch_size", "8", "--target_batch_size", "8",
+    "--test_batch_size", "16", "--group_size", "4",
+    "--log_interval", "1", "--ckpt_every_epochs", "1",
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    inject.disarm()
+
+
+def _run_digits(tmp_path, plan, extra=(), timeout=300):
+    """Spawn the digits CLI with ``plan`` armed; kill-on-timeout enforces
+    the matrix's no-hang guarantee from outside the process."""
+    ck = str(tmp_path / "ck")
+    jsonl = str(tmp_path / "m.jsonl")
+    argv = [
+        sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+        *_BASE_ARGS, "--ckpt_dir", ck, "--metrics_jsonl", jsonl, *extra,
+    ]
+    env = dict(os.environ)
+    env[inject.ENV_VAR] = json.dumps(plan)
+    proc = subprocess.Popen(
+        argv, cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.fail(
+            f"chaos run hung (plan={plan}) — the one outcome the matrix "
+            "forbids"
+        )
+    return proc.returncode, ck, jsonl, stderr.decode(errors="replace")
+
+
+def _kinds(jsonl):
+    if not os.path.exists(jsonl):
+        return []
+    return [json.loads(l)["kind"] for l in open(jsonl).read().splitlines()]
+
+
+def _assert_resumable(ck):
+    """'Resumable' as resume sees it: a newest step that VALIDATES
+    (manifest + recorded sizes) — not merely a directory that exists."""
+    step = latest_step(ck)
+    assert step is not None, f"no valid checkpoint under {ck}"
+    assert is_valid_checkpoint(os.path.join(ck, str(step)))
+    return step
+
+
+# ----------------------------------------------------- tier-1 chaos smoke
+
+
+def test_chaos_smoke_composed_faults_exit0_resumable(tmp_path):
+    """Fast tier-1 case, three fault kinds composed in ONE plan: a slow
+    step (the watchdog must tolerate a transient stall), one flaky save
+    write (the retry ladder must absorb it), then SIGTERM at a step
+    boundary (the preemption path must save and exit 0).  Ends with a
+    validated, genuinely restorable checkpoint."""
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        plan={
+            "slow_step_at": 2, "slow_step_s": 0.3,
+            "io_error_saves": 1,
+            "sigterm_at_step": 6,
+        },
+        extra=("--epochs", "500", "--watchdog_timeout", "120"),
+    )
+    assert rc == 0, f"stderr tail: {stderr[-2000:]}"
+    assert "preempt" in _kinds(jsonl)
+    step = _assert_resumable(ck)
+    assert step == 6  # the boundary the SIGTERM landed on
+
+    # Prove "resumable" end-to-end: an in-process relaunch restores the
+    # artifact (epochs == already-trained epochs -> restore + eval only).
+    from dwt_tpu.cli.usps_mnist import main
+
+    jsonl2 = str(tmp_path / "resume.jsonl")
+    acc = main([
+        *_BASE_ARGS, "--ckpt_dir", ck, "--metrics_jsonl", jsonl2,
+        "--epochs", "1",
+    ])
+    assert 0.0 <= acc <= 100.0
+    assert "resume" in _kinds(jsonl2)
+
+
+# ------------------------------------------------------- full matrix (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,plan,extra,expect",
+    [
+        # Preemption: SIGTERM at a boundary -> save-and-exit-0.
+        (
+            "sigterm",
+            {"sigterm_at_step": 6},
+            ("--epochs", "500"),
+            {"rc": 0, "kinds": ["preempt"], "resumable_step": 6},
+        ),
+        # NaN burst + halt policy -> diagnosed halt (logged divergence).
+        (
+            "nan_burst_halt",
+            {"nan_at_step": [5, 6, 7]},
+            ("--epochs", "500", "--guard_policy", "halt",
+             "--guard_interval", "1"),
+            {"rc": "nonzero", "kinds": ["divergence"],
+             "stderr": "non-finite"},
+        ),
+        # NaN + rollback policy -> restore the epoch checkpoint, finish.
+        (
+            "nan_rollback",
+            {"nan_at_step": 6},
+            ("--epochs", "3", "--guard_policy", "rollback",
+             "--guard_interval", "1"),
+            {"rc": 0, "kinds": ["rollback", "test"], "resumable": True},
+        ),
+        # Crash between checkpoint write and finalize rename: the error
+        # surfaces (diagnosed), the PREVIOUS checkpoint stays authoritative.
+        (
+            "crash_mid_save",
+            {"crash_in_save": 8},
+            ("--epochs", "500"),
+            {"rc": "nonzero", "stderr": "injected crash",
+             "resumable_step": 4},
+        ),
+        # Corrupt dataset item -> quarantined, run completes, id persisted.
+        (
+            "flaky_item",
+            {"corrupt_items": {"source": [3]}},
+            ("--epochs", "2"),
+            {"rc": 0, "resumable": True, "quarantine": True},
+        ),
+        # Transient save I/O (within the retry budget) -> absorbed.
+        (
+            "io_error_transient",
+            {"io_error_saves": 2},
+            ("--epochs", "2"),
+            {"rc": 0, "resumable_step": 8},
+        ),
+        # Persistent save I/O -> the save fails after bounded retries and
+        # the failure surfaces (diagnosed halt), no torn artifact.
+        (
+            "io_error_persistent",
+            {"io_error_saves": 99},
+            ("--epochs", "500"),
+            {"rc": "nonzero", "stderr": "injected I/O error"},
+        ),
+    ],
+)
+def test_chaos_matrix(tmp_path, name, plan, extra, expect):
+    rc, ck, jsonl, stderr = _run_digits(tmp_path, plan, extra)
+    if expect["rc"] == "nonzero":
+        assert rc not in (0, WATCHDOG_EXIT_CODE), (
+            f"{name}: expected diagnosed halt, got rc={rc}; "
+            f"stderr tail: {stderr[-2000:]}"
+        )
+    else:
+        assert rc == expect["rc"], (
+            f"{name}: rc={rc}; stderr tail: {stderr[-2000:]}"
+        )
+    for kind in expect.get("kinds", ()):
+        assert kind in _kinds(jsonl), f"{name}: no {kind!r} record"
+    if "stderr" in expect:
+        assert expect["stderr"] in stderr, (
+            f"{name}: diagnosis {expect['stderr']!r} missing from stderr "
+            f"tail: {stderr[-2000:]}"
+        )
+    if expect.get("resumable"):
+        _assert_resumable(ck)
+    if "resumable_step" in expect:
+        assert _assert_resumable(ck) == expect["resumable_step"], name
+    if expect.get("quarantine"):
+        qpath = os.path.join(ck, "quarantine.json")
+        assert os.path.exists(qpath), f"{name}: quarantine not persisted"
+        assert 3 in json.load(open(qpath))["source"]
+    # No torn state in any outcome: every finalized step dir validates.
+    for d in (os.listdir(ck) if os.path.isdir(ck) else []):
+        if d.isdigit():
+            assert is_valid_checkpoint(os.path.join(ck, d)), (
+                f"{name}: torn finalized checkpoint {d}"
+            )
+
+
+@pytest.mark.slow
+def test_chaos_hang_watchdog_diagnoses_and_exits_distinct(tmp_path):
+    """A mid-training hang (wedged collective stand-in) must not outlive
+    the watchdog: all-thread stacks land under ckpt_dir/watchdog/, the
+    exit code is the distinct WATCHDOG_EXIT_CODE, and the checkpoint from
+    the completed epoch remains valid for the relaunch."""
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        plan={"hang_at_step": 6},
+        extra=("--epochs", "500", "--watchdog_timeout", "12"),
+        timeout=240,
+    )
+    assert rc == WATCHDOG_EXIT_CODE, f"stderr tail: {stderr[-2000:]}"
+    assert "[watchdog]" in stderr
+    wd_dir = os.path.join(ck, "watchdog")
+    stacks = [f for f in os.listdir(wd_dir) if f.startswith("stacks-")]
+    assert stacks, "no stack dump written"
+    dump = open(os.path.join(wd_dir, stacks[0])).read()
+    assert "hang watchdog" in dump and "Thread" in dump
+    # The epoch-1 periodic save (step 4) predates the hang: resumable.
+    assert _assert_resumable(ck) == 4
+
+
+@pytest.mark.slow
+def test_chaos_two_process_consensus_sigterm_one_host(tmp_path):
+    """Acceptance: only process 1 receives SIGTERM; the step-boundary
+    consensus must turn it into an ALL-host save-and-exit-0 at the SAME
+    step — not a hung collective on process 0."""
+    port = _free_port()
+    procs, logs = [], []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PALLAS_AXON_POOL_IPS", inject.ENV_VAR)}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            DWT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            DWT_NUM_PROCESSES="2",
+            DWT_PROCESS_ID=str(rank),
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        if rank == 1:  # ONLY host 1 is preempted
+            env[inject.ENV_VAR] = json.dumps({"sigterm_at_step": 3})
+        jsonl = str(tmp_path / f"metrics_{rank}.jsonl")
+        logs.append(jsonl)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+                    "--synthetic", "--synthetic_size", "64",
+                    "--distributed", "--data_parallel",
+                    "--epochs", "500",  # only the consensus stop ends it
+                    "--group_size", "4",
+                    "--source_batch_size", "8",
+                    "--target_batch_size", "8",
+                    "--test_batch_size", "8",
+                    "--num_workers", "0",
+                    "--log_interval", "1",
+                    "--metrics_jsonl", jsonl,
+                    "--ckpt_dir", str(tmp_path / "shared_ck"),
+                    "--ckpt_every_epochs", "1000",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=REPO,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(
+            "consensus processes timed out — the un-signaled host is "
+            "likely hung in a collective (the exact failure consensus "
+            "exists to prevent)"
+        )
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    # Both hosts logged the consensus stop at the SAME step...
+    preempts = []
+    for path in logs:
+        recs = [json.loads(l) for l in open(path).read().splitlines()]
+        pre = [r for r in recs if r["kind"] == "preempt"]
+        assert pre, f"no preempt record in {path}"
+        preempts.append(pre[-1]["step"])
+    assert preempts[0] == preempts[1] == 3
+
+    # ...and the coordinated checkpoint is ONE valid artifact at that
+    # step.  (Layout varies by runtime: with fully-replicated state some
+    # orbax/jax combinations write everything from process 0, others add
+    # per-process ocdbt shards — validity, not layout, is the contract.)
+    ck = tmp_path / "shared_ck"
+    assert latest_step(str(ck)) == 3
+    assert is_valid_checkpoint(str(ck / "3"))
+    assert (ck / "3" / "ocdbt.process_0").exists()
+
+
+# ------------------------------------------------ FaultPlan spec parsing
+
+
+def _env_plan(monkeypatch, spec_json: str):
+    monkeypatch.setenv(inject.ENV_VAR, spec_json)
+
+
+def test_fault_plan_parses_composed_kinds(monkeypatch):
+    _env_plan(monkeypatch, json.dumps({
+        "nan_at_step": [3, 4], "sigterm_at_step": 6,
+        "slow_step_at": 2, "slow_step_s": 0.5,
+        "io_error_saves": 2, "crash_in_save": True,
+        "corrupt_items": {"source": [5], "target": [1, 2]},
+    }))
+    plan = FaultPlan.from_env()
+    assert plan.nan_at_step == [3, 4]
+    assert plan.sigterm_at_step == 6
+    assert plan.slow_step_at == 2 and plan.slow_step_s == 0.5
+    assert plan.io_error_saves == 2 and plan.crash_in_save is True
+    assert plan.corrupt_items == {"source": [5], "target": [1, 2]}
+
+
+def test_fault_plan_scalar_nan_stays_scalar(monkeypatch):
+    _env_plan(monkeypatch, json.dumps({"nan_at_step": 7}))
+    assert FaultPlan.from_env().nan_at_step == 7
+
+
+@pytest.mark.parametrize(
+    "spec,match",
+    [
+        ({"hang_at_stp": 3}, "unknown fault kind"),
+        ({"nan_at_step": "three"}, "int step"),
+        ({"nan_at_step": [3, 3]}, "duplicate steps"),
+        ({"nan_at_step": True}, "int step"),
+        ({"hang_at_step": 4, "sigterm_at_step": 4}, "pick one control fault"),
+        # Even at DIFFERENT steps: chunked dispatch can land both on one
+        # boundary, where the hang silently swallows the SIGTERM.
+        ({"hang_at_step": 9, "sigterm_at_step": 5}, "cannot compose"),
+        ({"slow_step_s": -1.0}, "non-negative"),
+        ({"slow_step_s": 30}, "arms nothing"),
+        ({"io_error_saves": -2}, "non-negative"),
+        ({"crash_in_save": "yes"}, "true .* or an"),
+        ({"corrupt_items": {"eval": [1]}}, "source"),
+        ({"corrupt_items": [1, 2]}, "map a stream role"),
+    ],
+)
+def test_fault_plan_rejects_bad_specs(monkeypatch, spec, match):
+    _env_plan(monkeypatch, json.dumps(spec))
+    with pytest.raises(ValueError, match=match):
+        FaultPlan.from_env()
+
+
+def test_fault_plan_rejects_duplicate_kinds(monkeypatch):
+    # json.loads would silently keep the LAST value; the plan must refuse.
+    _env_plan(monkeypatch, '{"nan_at_step": 1, "nan_at_step": 2}')
+    with pytest.raises(ValueError, match="duplicate fault kind"):
+        FaultPlan.from_env()
+
+
+def test_fault_plan_rejects_non_object(monkeypatch):
+    _env_plan(monkeypatch, "[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        FaultPlan.from_env()
+
+
+def test_fault_plan_rejects_invalid_json(monkeypatch):
+    _env_plan(monkeypatch, "{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FaultPlan.from_env()
+
+
+def test_fault_plan_nan_burst_fires_each_step_once():
+    """Burst semantics drive the escalation ladder: every listed step
+    fires exactly once, so the poison re-strikes after each recovery."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    @dataclasses.dataclass
+    class _State:
+        params: dict
+
+        def replace(self, params):
+            return _State(params)
+
+    def _nan(metrics):
+        return bool(np.isnan(np.asarray(metrics["loss"])))
+
+    fresh = lambda: {"loss": jnp.ones(())}
+    inject.arm(FaultPlan(nan_at_step=[2, 4]))
+    s = _State({"w": jnp.ones(2)})
+    _, m1 = inject.maybe_nan(s, fresh(), 1)
+    assert not _nan(m1)  # step 1: not armed
+    s2, m2 = inject.maybe_nan(s, fresh(), 2)
+    assert _nan(m2)  # step 2 fired
+    assert np.isnan(np.asarray(s2.params["w"])).all()
+    _, m3 = inject.maybe_nan(s, fresh(), 2)
+    assert not _nan(m3)  # step 2 is spent
+    _, m4 = inject.maybe_nan(s, fresh(), 3, 5)
+    assert _nan(m4)  # step 4 fired inside the chunk range
+    assert inject.current().nan_at_step is None  # burst exhausted

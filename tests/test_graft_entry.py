@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -50,3 +52,25 @@ def test_dryrun_reexecs_clean_when_hijack_armed():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_16_devices_covers_4_slices_and_consensus():
+    """The widened dryrun: a 16-fake-device mesh must exercise BOTH the
+    2x8 and 4x4 (dcn, data) layouts plus the forced consensus allgather
+    (the flag-vector collective the loops issue at step boundaries) —
+    coverage beyond the 8-dev/2-slice corner.  Direct --dryrun subprocess
+    (own XLA device count), no relay re-exec involved."""
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"),
+         "--dryrun", "16"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK: 16-device mesh" in proc.stdout
+    assert "2x8 (dcn, data) mesh" in proc.stdout
+    assert "4x4 (dcn, data) mesh" in proc.stdout
+    assert "dryrun consensus OK" in proc.stdout

@@ -447,6 +447,201 @@ def test_guard_rollback_without_checkpoint_uses_memory_snapshot(tmp_path):
     assert rollbacks and rollbacks[0]["source"] == "memory"
 
 
+def test_guard_ladder_transient_nan_backs_off_and_recovers(tmp_path):
+    """Acceptance (ladder, transient): a one-off NaN engages the lr_backoff
+    rung — revert to the in-memory good state, scale updates down — and
+    after the configured clean checks the scale recovers.  NO rollback is
+    spent, NO checkpoint restore happens.
+
+    The same run also proves --keep_ckpts pruning (one CLI run serves
+    both assertions — the tier-1 budget is full): the main dir keeps only
+    the newest N periodic steps while anchors are never pruned."""
+    from dwt_tpu.cli.usps_mnist import main
+    from dwt_tpu.train.loop import _anchor_dir
+
+    ck = str(tmp_path / "ck")
+    inject.arm(FaultPlan(nan_at_step=3))
+    acc = main(
+        _digits_argv(
+            tmp_path,
+            epochs=3,
+            guard_policy="rollback",
+            guard_interval=1,
+            guard_lr_backoff=0.5,
+            guard_backoff_recovery=2,
+            ckpt_dir=ck,
+            ckpt_every_epochs=1,
+            anchor_every=1,
+            keep_ckpts=2,
+        )
+    )
+    assert 0.0 <= acc <= 100.0
+    recs = _records(tmp_path)
+    kinds = [r["kind"] for r in recs]
+    assert "lr_backoff" in kinds and "lr_recover" in kinds
+    assert "rollback" not in kinds  # the mild rung absorbed the spike
+    backoff = next(r for r in recs if r["kind"] == "lr_backoff")
+    recover = next(r for r in recs if r["kind"] == "lr_recover")
+    assert backoff["scale"] == 0.5 and recover["scale"] == 1.0
+    tests = [r for r in recs if r["kind"] == "test"]
+    assert tests[-1]["epoch"] == 2 and np.isfinite(tests[-1]["loss"])
+    # keep_ckpts: the in-memory revert at step 3 shifts epoch boundaries
+    # back one step (state.step regresses by 1, gstep does not), so the
+    # three periodic saves land at 3, 7, 11 — pruned to the newest 2;
+    # per-epoch anchors keep all three.
+    assert valid_steps(ck) == [7, 11]
+    assert valid_steps(_anchor_dir(ck)) == [3, 7, 11]
+
+
+def test_guard_ladder_persistent_nan_escalates_in_order(tmp_path):
+    """Acceptance (ladder, persistent): a NaN burst walks the full ladder —
+    lr_backoff first, then (striking again while backed off) rollback,
+    then (rollback budget spent) halt — in that order."""
+    from dwt_tpu.cli.usps_mnist import main
+
+    ck = str(tmp_path / "ck")
+    # Steps 6,7,8 poisoned: 6 engages the backoff rung, 7 strikes while
+    # backed off (escalate: rollback to the epoch-1 checkpoint), 8 strikes
+    # during the still-backed-off replay (rollback budget of 1 is spent:
+    # halt).  Recovery is set far out so the scale cannot recover between
+    # strikes and blur the ladder order.
+    inject.arm(FaultPlan(nan_at_step=[6, 7, 8]))
+    with pytest.raises(DivergenceError, match="rollbacks already spent"):
+        main(
+            _digits_argv(
+                tmp_path,
+                epochs=3,
+                guard_policy="rollback",
+                guard_interval=1,
+                guard_lr_backoff=0.5,
+                guard_backoff_recovery=100,
+                guard_max_rollbacks=1,
+                ckpt_dir=ck,
+                ckpt_every_epochs=1,
+            )
+        )
+    kinds = [r["kind"] for r in _records(tmp_path)]
+    assert "lr_backoff" in kinds and "rollback" in kinds
+    assert kinds.index("lr_backoff") < kinds.index("rollback")
+    assert "lr_recover" not in kinds
+
+
+def _ladder_state():
+    """Minimal REAL TrainState with a backoff-wrapped tx (cheap: no model
+    init) for direct guard-ladder unit tests."""
+    import optax
+
+    from dwt_tpu.train.optim import with_lr_backoff
+    from dwt_tpu.train.state import TrainState
+
+    tx = with_lr_backoff(optax.sgd(0.1))
+    params = {"w": jnp.ones(3)}
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+    )
+
+
+def test_guard_skip_escalation_keeps_backed_off_scale():
+    """Regression: a skip_step escalation WHILE backed off must return a
+    state still carrying the reduced scale — the good snapshot predates
+    the backoff engagement, so handing it back verbatim would replay at
+    exactly the lr that just diverged (and desync the guard's host
+    mirror from the device scale)."""
+    from dwt_tpu.train.optim import get_backoff_scale
+
+    guard = DivergenceGuard(
+        "skip_step", interval=1, lr_backoff=0.5, backoff_recovery=100
+    )
+    state = _ladder_state()
+    guard.prime(state)
+    bad = {"loss": jnp.asarray(float("nan"))}
+    s1 = guard.step(state, bad, 1, 1)  # rung 1: backoff
+    assert get_backoff_scale(s1.opt_state) == 0.5 and guard.in_backoff
+    s2 = guard.step(s1, bad, 1, 2)  # escalation: skip while backed off
+    assert get_backoff_scale(s2.opt_state) == 0.5  # scale survives
+    assert guard.recoveries == 2
+
+
+def test_guard_mirror_recovery_takes_same_rung():
+    """The consensus mirror path: a host whose metrics looked finite must
+    take the SAME in-memory rung the remote host reported — first the
+    backoff engagement, then (still backed off) the skip escalation."""
+    from dwt_tpu.train.optim import get_backoff_scale
+
+    guard = DivergenceGuard(
+        "skip_step", interval=1, lr_backoff=0.5, backoff_recovery=100
+    )
+    state = _ladder_state()
+    guard.prime(state)
+    s1 = guard.mirror_recovery(state, 3)
+    assert get_backoff_scale(s1.opt_state) == 0.5 and guard.in_backoff
+    s2 = guard.mirror_recovery(s1, 4)
+    assert get_backoff_scale(s2.opt_state) == 0.5
+    assert guard.recoveries == 2
+
+
+def test_guard_mirror_reverts_to_pre_refresh_snapshot():
+    """Regression: a host whose check PASSED at this boundary refreshed
+    its good snapshot to the CURRENT state; mirroring a remote divergence
+    must revert to the snapshot both hosts hold (the previous passing
+    check), not the just-refreshed one — else the finite host 'reverts'
+    to where it already is and the replicas fork."""
+    guard = DivergenceGuard("skip_step", interval=1)
+    state_a = _ladder_state()
+    state_b = state_a.replace(
+        params=jax.tree.map(lambda x: x * 2.0, state_a.params)
+    )
+    guard.prime(state_a)
+    ok = {"loss": jnp.ones(())}
+    out = guard.step(state_b, ok, 1, 1)  # passing check refreshes to B
+    assert float(jax.tree.leaves(out.params)[0][0]) == 2.0
+    mirrored = guard.mirror_recovery(out, 1)
+    # Reverted to A — the snapshot the remote (failed-check) host used.
+    assert float(jax.tree.leaves(mirrored.params)[0][0]) == 1.0
+
+
+def test_consensus_event_codes_escalate_by_max():
+    """Flag-vector combination: the max event code across hosts governs
+    (halt > rollback > recovered > none) — exercised through the forced
+    1-process allgather path."""
+    from dwt_tpu.resilience.coord import (
+        EVENT_HALT,
+        EVENT_NONE,
+        EVENT_RECOVERED,
+        EVENT_ROLLBACK,
+        Coordinator,
+    )
+
+    coord = Coordinator(enabled=True)
+    d = coord.decide()
+    assert d.event == EVENT_NONE and not d.diverged and not d.stop
+    d = coord.decide(event=EVENT_RECOVERED)
+    assert d.event == EVENT_RECOVERED and d.diverged
+    d = coord.decide(stop=True, event=EVENT_ROLLBACK, rollback_step=9)
+    assert d.stop and d.event == EVENT_ROLLBACK and d.rollback_step == 9
+    assert EVENT_HALT > EVENT_ROLLBACK > EVENT_RECOVERED > EVENT_NONE
+    assert coord.agree_step(5) == 5
+
+
+def test_guard_backoff_without_policy_is_rejected():
+    """--guard_lr_backoff with no active guard would be a silent no-op —
+    the loop must refuse loudly instead (direct _make_guard call: the
+    full CLI path would spend seconds on model init before the check)."""
+    from dwt_tpu.config import DigitsConfig
+    from dwt_tpu.train.loop import _make_guard
+
+    with pytest.raises(ValueError, match="guard_lr_backoff"):
+        _make_guard(DigitsConfig(guard_lr_backoff=0.5), None)
+
+
+def test_guard_rejects_bad_backoff_factor():
+    with pytest.raises(ValueError, match="lr_backoff"):
+        DivergenceGuard("halt", interval=1, lr_backoff=1.5)
+
+
 def test_guard_gives_up_after_max_rollbacks():
     guard = DivergenceGuard("rollback", interval=1, max_rollbacks=0)
     guard.prime({"w": jnp.ones(2)})
@@ -695,6 +890,23 @@ def test_checkpoint_io_retry_backoff():
 # ----------------------------------------------------------- preemption
 
 
+def test_watchdog_suspended_masks_blocking_section(tmp_path):
+    """A synchronous checkpoint save may legitimately outlast the
+    timeout; inside ``suspended()`` the watchdog must not fire, and the
+    section's duration must not count against the next interval."""
+    from dwt_tpu.resilience import HangWatchdog
+
+    calls = []
+    wd = HangWatchdog(0.2, ckpt_dir=str(tmp_path), _exit=calls.append)
+    with wd:
+        with wd.suspended():
+            time.sleep(0.6)  # 3x the timeout: would fire if unmasked
+        assert not wd.fired
+        time.sleep(0.1)  # exit re-heartbeat: interval not yet exceeded
+        assert not wd.fired
+    assert calls == []
+
+
 def test_preemption_handler_flag_and_restore():
     before = signal.getsignal(signal.SIGTERM)
     with PreemptionHandler() as p:
@@ -758,7 +970,17 @@ def _assert_graceful_exit(proc, ck, jsonl):
     assert "preempt" in kinds
 
 
-@pytest.mark.parametrize("dispatch", ["1", "4"])
+@pytest.mark.parametrize(
+    "dispatch",
+    [
+        "1",
+        # The chunked variant costs a second full trainer subprocess;
+        # the chunked preemption path is equally proven by the slow-tier
+        # chaos matrix + the chunked guard-rollback test above, so only
+        # the per-step variant rides in the (full) tier-1 budget.
+        pytest.param("4", marks=pytest.mark.slow),
+    ],
+)
 def test_sigterm_saves_final_checkpoint_and_exits_zero(tmp_path, dispatch):
     """Acceptance (d): SIGTERM mid-training -> final checkpoint, a preempt
     record, exit 0 — on the per-step AND steps_per_dispatch paths.  With
