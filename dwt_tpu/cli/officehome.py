@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "over k stacked batches; chunks cut at eval/"
                         "checkpoint boundaries) — amortizes host "
                         "dispatch latency; same numerics")
+    p.add_argument("--eval_steps_per_dispatch", type=int,
+                   default=d.eval_steps_per_dispatch,
+                   help="k eval/stat-collection batches per scanned "
+                        "dispatch; eval counters stay device-resident "
+                        "across the whole pass (O(1) host fetches) and "
+                        "the 10-pass stat-collection protocol dispatches "
+                        "at the same granularity")
     p.add_argument("--init_ckpt", type=str, default=None,
                    help="read-only Orbax init artifact (written by "
                         "dwt-convert); unlike --ckpt_dir it is never "

@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">1: run k train steps per dispatch (lax.scan "
                         "over k stacked batches) — amortizes host "
                         "dispatch latency; same numerics")
+    p.add_argument("--eval_steps_per_dispatch", type=int,
+                   default=d.eval_steps_per_dispatch,
+                   help="k eval batches per scanned dispatch; counters "
+                        "stay device-resident across the whole eval "
+                        "pass (O(1) host fetches), ragged tails are "
+                        "pad-and-masked so counts stay exact")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--async_ckpt", action=argparse.BooleanOptionalAction,

@@ -47,6 +47,12 @@ class DigitsConfig:
     # batches) — amortizes the per-dispatch host round-trip; numerics
     # match the single-step path (tests/test_train.py).
     steps_per_dispatch: int = 1
+    # Eval-path twin of steps_per_dispatch: k eval batches per scanned
+    # dispatch, counters device-resident across the whole pass (O(1)
+    # host fetches per eval — tests/test_evalpipe.py).  Exact counts via
+    # pad-and-mask; default >1 because the eval path has no optimizer
+    # state to perturb and the amortization is pure win.
+    eval_steps_per_dispatch: int = 8
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     # >0: prune the MAIN ckpt_dir to the newest N steps after each
@@ -123,6 +129,10 @@ class OfficeHomeConfig:
     # chunks are cut at eval/checkpoint boundaries so the check_acc_step
     # and ckpt_every_iters cadences hold exactly.
     steps_per_dispatch: int = 1
+    # k eval/stat-collection batches per scanned dispatch — see
+    # DigitsConfig.eval_steps_per_dispatch.  Also governs the 10-pass
+    # stat-collection protocol's dispatch granularity.
+    eval_steps_per_dispatch: int = 8
     init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000

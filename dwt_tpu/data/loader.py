@@ -223,6 +223,7 @@ def batch_iterator(
     quarantine: bool = True,
     quarantine_registry: Optional[QuarantineRegistry] = None,
     quarantine_key: str = "items",
+    pad_and_mask: bool = False,
 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield tuples of stacked numpy batches from an indexable dataset.
 
@@ -253,18 +254,47 @@ def batch_iterator(
     * ``quarantine_registry``/``quarantine_key``: persist quarantined ids
       (per stream role) so a resumed run skips known-bad items without a
       single access attempt — the skipped item follows the same drop/
-      substitute semantics as a freshly quarantined one.
+      substitute semantics as a freshly quarantined one;
+    * ``pad_and_mask=True`` (eval/stat pipelines): every yielded tuple
+      gains a trailing boolean ``mask`` array and every batch is padded
+      to exactly ``batch_size`` samples (the ragged tail repeats its last
+      item with ``mask=False``), so all batches share ONE compiled shape
+      and masked counters stay exact.  Under ``shard`` the epoch is
+      padded to a multiple of ``count * batch_size`` first, so every
+      process yields the SAME number of identically-shaped batches — the
+      collective eval step's no-deadlock invariant — while the union of
+      ``mask=True`` samples across processes is each real sample exactly
+      once.  Requires ``shuffle=False, drop_last=False`` (evaluation
+      semantics; padding a shuffled training epoch would be a bug).  A
+      quarantined item is substituted and masked out — the masked count
+      excludes it, matching the unsharded drop semantics.
     """
     n = len(dataset)
     order = np.arange(n)
     if shuffle:
         order = np.random.default_rng((seed, epoch)).permutation(n)
+    mask = None
+    if pad_and_mask:
+        if shuffle or drop_last:
+            raise ValueError(
+                "pad_and_mask is an eval-path contract: it requires "
+                "shuffle=False and drop_last=False"
+            )
+        span = batch_size * (shard[1] if shard is not None else 1)
+        target = ((n + span - 1) // span) * span
+        mask = np.ones(target, bool)
+        if target > n:
+            mask[n:] = False
+            pad_src = order[-1:] if n else np.zeros(1, order.dtype)
+            order = np.concatenate([order, np.repeat(pad_src, target - n)])
     if shard is not None:
         index, count = shard
         if drop_last:
             usable = n - n % (count * batch_size)
             order = order[:usable]
         order = order[index::count]
+        if mask is not None:
+            mask = mask[index::count]
     stop = len(order) - (len(order) % batch_size if drop_last else 0)
     indices = order[:stop]
     token_of = lambda i: (seed, epoch, int(i))
@@ -285,19 +315,28 @@ def batch_iterator(
             for i in indices
         )
 
-    def _emit(batch):
-        return tuple(
+    masked = mask is not None
+
+    def _emit(batch, bits):
+        fields = tuple(
             _stack([item[f] for item in batch]) for f in range(len(batch[0]))
         )
+        if masked:
+            fields += (np.asarray(bits, bool),)
+        return fields
 
-    batch = []
+    batch, bits = [], []
     last_good = None
-    deficit = 0  # quarantined items seen before the first good one (sharded)
-    for item in items_iter:
+    deficit = 0  # quarantined items seen before the first good one
+    for pos, item in enumerate(items_iter):
+        bit = bool(mask[pos]) if masked else True
         if item is QUARANTINED:
-            if shard is None:
+            if shard is None and not masked:
                 continue
-            # Sharded: substitute instead of dropping (see docstring).
+            # Sharded/masked: substitute instead of dropping (see
+            # docstring); the masked slot counts as absent either way.
+            if masked:
+                bit = False
             if last_good is None:
                 deficit += 1
                 continue
@@ -305,20 +344,23 @@ def batch_iterator(
         else:
             if deficit:
                 # Repay leading quarantined slots now that a good item
-                # exists, keeping this shard's item count exact.
+                # exists, keeping this shard's item count exact (masked
+                # repaid slots stay excluded from the counters).
                 for _ in range(deficit):
                     batch.append(item)
+                    bits.append(not masked)
                     if len(batch) == batch_size:
-                        yield _emit(batch)
-                        batch = []
+                        yield _emit(batch, bits)
+                        batch, bits = [], []
                 deficit = 0
             last_good = item
         batch.append(item)
+        bits.append(bit)
         if len(batch) == batch_size:
-            yield _emit(batch)
-            batch = []
+            yield _emit(batch, bits)
+            batch, bits = [], []
     if batch and not drop_last:  # trailing partial batch
-        yield _emit(batch)
+        yield _emit(batch, bits)
 
 
 def infinite(

@@ -56,6 +56,8 @@ def nll_loss(
         return -jnp.mean(picked)
     if reduction == "sum":
         return -jnp.sum(picked)
+    if reduction == "none":
+        return -picked
     raise ValueError(f"unknown reduction {reduction!r}")
 
 

@@ -65,7 +65,7 @@ def _auto_interpret() -> bool:
 # ------------------------------------------------------------- pass 1
 
 
-def _moments_kernel(x_ref, s1_ref, s2_ref, *, total_rows, tile_m, g):
+def _moments_kernel(x_ref, s1_ref, s2_ref, *, total_rows, tile_m):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -79,20 +79,25 @@ def _moments_kernel(x_ref, s1_ref, s2_ref, *, total_rows, tile_m, g):
     rows = lax.broadcasted_iota(jnp.int32, (tile_m, 1), 0) + i * tile_m
     x = jnp.where(rows < total_rows, x, 0.0)
     s1_ref[:] += jnp.sum(x, axis=0, keepdims=True)
-    c = x.shape[-1]
-    xg = x.reshape(tile_m, c // g, g)
-    # Batched over groups: [G, g, g] second-moment contribution.  HIGHEST
-    # precision as in the XLA op's group_cov: statistics feeding a Cholesky
-    # must not ride the TPU's default bf16 multiply passes — doubly so here,
-    # where the E[xxᵀ]−mmᵀ subtraction cancels leading bits.
-    prod = jnp.einsum(
-        "mgc,mgd->gcd",
-        xg,
-        xg,
-        preferred_element_type=jnp.float32,
+    # Full xᵀx [C, C] as ONE 2-D dot; _moments_call extracts the per-group
+    # diagonal blocks outside the kernel.  Mosaic (this jax line) lowers
+    # only 2-D dots — the per-group batched einsum ([G, g, g] directly)
+    # is a 3-D dot_general and fails TPU lowering (pinned off-chip by
+    # tests/test_pallas_whitening.py::test_kernels_lower_for_tpu_offchip).
+    # The off-block products are wasted MXU FLOPs (C/g per useful one),
+    # but the op is HBM-bound (PERF.md: 1.4% of step FLOPs) and the full
+    # dot keeps the MXU on its native path; the whitened sites' widest C
+    # is 256, so the VMEM accumulator stays ≤ 256 KB f32.  HIGHEST
+    # precision as in the XLA op's group_cov: statistics feeding a
+    # Cholesky must not ride the TPU's default bf16 multiply passes —
+    # doubly so here, where E[xxᵀ]−mmᵀ cancels leading bits.
+    s2_ref[:] += lax.dot_general(
+        x,
+        x,
+        dimension_numbers=(((0,), (0,)), ((), ())),
         precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
     )
-    s2_ref[:] += prod.reshape(c, g)
 
 
 def _moments_call(
@@ -103,7 +108,7 @@ def _moments_call(
     tile_m = min(_TILE_M, max(8, m_rows))
     grid = (pl.cdiv(m_rows, tile_m),)
     kernel = functools.partial(
-        _moments_kernel, total_rows=m_rows, tile_m=tile_m, g=group_size
+        _moments_kernel, total_rows=m_rows, tile_m=tile_m
     )
     s1, s2 = pl.pallas_call(
         kernel,
@@ -111,16 +116,22 @@ def _moments_call(
         in_specs=[pl.BlockSpec((tile_m, c), lambda i: (i, 0))],
         out_specs=(
             pl.BlockSpec((1, c), lambda i: (0, 0)),
-            pl.BlockSpec((c, group_size), lambda i: (0, 0)),
+            pl.BlockSpec((c, c), lambda i: (0, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((1, c), jnp.float32),
-            jax.ShapeDtypeStruct((c, group_size), jnp.float32),
+            jax.ShapeDtypeStruct((c, c), jnp.float32),
         ),
         interpret=interpret,
     )(x2d)
     mean = s1[0] / m_rows
-    e_xx = s2.reshape(num_groups, group_size, group_size) / m_rows
+    # Group-diagonal blocks of the full second-moment matrix — the same
+    # sums the per-group einsum produced, reduced by the same f32 dot.
+    gi = jnp.arange(num_groups)
+    e_xx = (
+        s2.reshape(num_groups, group_size, num_groups, group_size)[gi, :, gi, :]
+        / m_rows
+    )
     mg = mean.reshape(num_groups, group_size)
     cov = e_xx - jnp.einsum("gc,gd->gcd", mg, mg)
     return mean, cov
@@ -129,18 +140,22 @@ def _moments_call(
 # ------------------------------------------------------------- pass 2
 
 
-def _apply_kernel(x_ref, m_ref, w_ref, o_ref, *, g, compute_dtype):
+def _apply_kernel(x_ref, m_ref, w_ref, o_ref, *, compute_dtype):
     x = x_ref[:]
     xn = (x.astype(jnp.float32) - m_ref[:]).astype(compute_dtype)
-    tile_m, c = xn.shape
-    xg = xn.reshape(tile_m, c // g, g)
-    wg = w_ref[:].astype(compute_dtype).reshape(c // g, g, g)
-    # y_gd = Σ_c W_g[d, c] · xn_g[c] — the grouped 1x1 conv as a batched
-    # matmul (reference whitening.py:55).
-    y = jnp.einsum(
-        "mgc,gdc->mgd", xg, wg, preferred_element_type=jnp.float32
+    # y[m, d] = Σ_c W_bd[d, c] · xn[m, c] with W_bd the block-diagonal
+    # whitening matrix: the grouped 1x1 conv (reference whitening.py:55)
+    # as ONE 2-D matmul — Mosaic-lowerable (see _moments_kernel) and on
+    # the MXU's native path; zeros off the blocks are wasted FLOPs the
+    # HBM-bound op never notices.  Matmul in the activation dtype (bf16
+    # MXU path), f32 accumulation.
+    y = lax.dot_general(
+        xn,
+        w_ref[:].astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
-    o_ref[:] = y.reshape(tile_m, c).astype(o_ref.dtype)
+    o_ref[:] = y.astype(o_ref.dtype)
 
 
 def _apply_call(
@@ -149,26 +164,27 @@ def _apply_call(
     w: jax.Array,
     interpret: bool,
 ) -> jax.Array:
-    """``(x − m) @ Wᵀ`` per group; matmul in the activation dtype."""
+    """``(x − m) @ W_bdᵀ`` with ``w [G, g, g]`` expanded block-diagonal;
+    matmul in the activation dtype."""
+    from jax.scipy.linalg import block_diag
+
     m_rows, c = x2d.shape
-    g = w.shape[-1]
     tile_m = min(_TILE_M, max(8, m_rows))
     grid = (pl.cdiv(m_rows, tile_m),)
-    kernel = functools.partial(
-        _apply_kernel, g=g, compute_dtype=x2d.dtype
-    )
+    kernel = functools.partial(_apply_kernel, compute_dtype=x2d.dtype)
+    w_bd = block_diag(*w)  # [C, C]; block g at rows/cols g·gs
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
             pl.BlockSpec((1, c), lambda i: (0, 0)),
-            pl.BlockSpec((c, g), lambda i: (0, 0)),
+            pl.BlockSpec((c, c), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((tile_m, c), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((m_rows, c), x2d.dtype),
         interpret=interpret,
-    )(x2d, mean.reshape(1, c).astype(jnp.float32), w.reshape(c, g))
+    )(x2d, mean.reshape(1, c).astype(jnp.float32), w_bd)
 
 
 # ------------------------------------------------- differentiable train path

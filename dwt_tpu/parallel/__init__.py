@@ -20,6 +20,8 @@ from dwt_tpu.parallel.mesh import (
     initialize_distributed,
 )
 from dwt_tpu.parallel.dp import (
+    make_sharded_collect_step,
+    make_sharded_eval_step,
     make_sharded_scanned_step,
     make_sharded_train_step,
     shard_batch,
@@ -31,6 +33,8 @@ __all__ = [
     "DCN_AXIS",
     "make_mesh",
     "initialize_distributed",
+    "make_sharded_collect_step",
+    "make_sharded_eval_step",
     "make_sharded_scanned_step",
     "make_sharded_train_step",
     "shard_batch",

@@ -91,6 +91,56 @@ def _chunk_spec(mesh: Mesh) -> P:
     return P(None, tuple(mesh.axis_names))
 
 
+def make_sharded_eval_step(
+    accum_eval: Callable,
+    mesh: Mesh,
+    jit: bool = True,
+) -> Callable:
+    """shard_map an accumulating eval dispatch over ``mesh``.
+
+    ``accum_eval`` is ``steps.make_accum_eval_step(model, axis_name=
+    tuple(mesh.axis_names))``: counters/params/stats replicated, the
+    ``{"x", "y", "mask"}`` chunk sharded on its sample axis (axis 1 —
+    chunk layout ``[k, batch, ...]``), and the chunk's counter deltas
+    ``psum``'d across the mesh inside the step, so the returned counters
+    are the GLOBAL accumulators on every replica — the eval-path twin of
+    :func:`make_sharded_train_step`'s counter psum.
+    """
+    mapped = _shard_map(
+        accum_eval,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), _chunk_spec(mesh)),
+        out_specs=P(),
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
+def make_sharded_collect_step(
+    scanned_collect: Callable,
+    mesh: Mesh,
+    jit: bool = True,
+) -> Callable:
+    """shard_map a scanned stat-collection dispatch over ``mesh``.
+
+    ``scanned_collect`` is ``steps.make_scanned_collect(collect_fn)``
+    where ``collect_fn``'s model carries the mesh axis name(s): each
+    replica forwards its slice of every collection batch and the norm
+    sites ``pmean`` their moments across the mesh, so the EMA update
+    every replica applies is computed from the GLOBAL batch moments —
+    the stats trajectory of the unsharded reference path, to float
+    reassociation tolerance (``tests/test_evalpipe.py``).  State is
+    replicated; ``xs`` is ``[k, batch, ...]`` with the sample axis
+    sharded.
+    """
+    mapped = _shard_map(
+        scanned_collect,
+        mesh=mesh,
+        in_specs=(P(), _chunk_spec(mesh)),
+        out_specs=P(),
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
 def shard_batch(batch: Any, mesh: Mesh, chunked: bool = False) -> Any:
     """Place every batch leaf with its leading axis sharded over the mesh
     (``chunked=True``: the SECOND axis — leaf layout ``[k, batch, ...]``).

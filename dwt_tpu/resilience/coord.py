@@ -36,6 +36,7 @@ CI exercises the consensus code on a single host.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import numpy as np
@@ -77,6 +78,15 @@ class Coordinator:
         self.enabled = (
             self.process_count > 1 if enabled is None else bool(enabled)
         )
+        # Decision-latency accounting: the per-boundary allgather is a
+        # real per-step cost on DCN-connected hosts, and a latency spike
+        # is the earliest visible symptom of a straggling/preempted peer.
+        # The loops surface these through the metrics stream (the
+        # "consensus" record kind).
+        self.decides = 0
+        self.last_decide_s = 0.0
+        self.total_decide_s = 0.0
+        self.max_decide_s = 0.0
 
     @property
     def multi_host(self) -> bool:
@@ -111,9 +121,15 @@ class Coordinator:
         """
         if not self.enabled:
             return Decision(bool(stop), int(event), int(rollback_step))
+        t0 = time.perf_counter()
         gathered = self._allgather(
             [int(bool(stop)), int(event), int(rollback_step)]
         )
+        dt = time.perf_counter() - t0
+        self.decides += 1
+        self.last_decide_s = dt
+        self.total_decide_s += dt
+        self.max_decide_s = max(self.max_decide_s, dt)
         return Decision(
             stop=bool(gathered[:, 0].any()),
             event=int(gathered[:, 1].max()),

@@ -11,13 +11,17 @@ buffers.  Host code only feeds batches and logs metrics.
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.optim import adam_l2, multistep_schedule, sgd_two_group
 from dwt_tpu.train.steps import (
+    eval_counters,
+    make_accum_eval_step,
     make_digits_train_step,
     make_eval_step,
     make_officehome_train_step,
+    make_scanned_collect,
     make_scanned_step,
     make_stat_collection_step,
     stack_batches,
 )
+from dwt_tpu.train.evalpipe import EvalPipeline
 
 __all__ = [
     "TrainState",
@@ -25,9 +29,13 @@ __all__ = [
     "adam_l2",
     "multistep_schedule",
     "sgd_two_group",
+    "EvalPipeline",
+    "eval_counters",
+    "make_accum_eval_step",
     "make_digits_train_step",
     "make_eval_step",
     "make_officehome_train_step",
+    "make_scanned_collect",
     "make_scanned_step",
     "make_stat_collection_step",
     "stack_batches",

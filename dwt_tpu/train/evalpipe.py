@@ -1,0 +1,379 @@
+"""Device-resident, mesh-sharded eval & stat-collection pipeline.
+
+The reference protocols make the eval phase expensive by construction:
+OfficeHome re-estimates whitening/BN statistics with a 10-pass sweep over
+the target test set before every final test (``resnet50…py:380-389``), so
+eval-phase cost is ~11 full dataset passes per cadence.  Until ISSUE-4
+that phase ran as the repo's ONLY un-optimized device loop: unsharded
+(every process redundantly forwarding full batches on one device) with a
+blocking ``float()`` host sync per batch — while the train path already
+had sharding, scan-amortized dispatch, and prefetch.
+
+:class:`EvalPipeline` gives eval and stat-collection the same levers:
+
+* **mesh sharding** (``--data_parallel``): each device forwards ``1/N``
+  of every eval/stat batch via ``parallel.make_sharded_eval_step`` /
+  ``make_sharded_collect_step`` — counter deltas ``psum``'d, norm-site
+  moments ``pmean``'d, composed with the per-process multi-host split
+  exactly like the train step;
+* **device-resident accumulation**: the three eval counters live on
+  device across the whole pass and the host fetches them ONCE
+  (``steps.eval_counters`` / ``make_accum_eval_step``), so a full
+  :meth:`evaluate` performs O(1) host fetches instead of one blocking
+  sync per batch;
+* **scanned dispatch** (``--eval_steps_per_dispatch k``): k batches per
+  compiled dispatch via ``lax.scan``, amortizing the per-dispatch host
+  round-trip k-fold (the eval twin of ``--steps_per_dispatch``);
+* **prefetch**: both phases stage batches through
+  ``prefetch_to_device`` with the training loops' staging depth.
+
+Parity contract (pinned by ``tests/test_evalpipe.py``): sharded and
+unsharded evals produce IDENTICAL correct/count counters (masked padding
+keeps ragged tails exact), and sharded stat collection reproduces the
+unsharded stats trajectory to the same float-reassociation tolerance
+``tests/test_parallel.py`` holds the train step to.  Stat-collection
+batches are never padded — padding would perturb the batch moments the
+protocol exists to estimate — so a ragged final batch runs through the
+axis-free tail step, bitwise-identically to the unsharded path.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dwt_tpu.data.loader import (
+    QUARANTINED,
+    _load_item,
+    batch_iterator,
+    prefetch_to_device,
+)
+from dwt_tpu.train.steps import (
+    eval_counters,
+    make_accum_eval_step,
+    make_scanned_collect,
+    make_stat_collection_step,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _fetch(tree):
+    """The ONE device→host rendezvous of an eval pass.
+
+    Every host materialization in this module funnels through here so a
+    counting shim (tests, ``tools/eval_bench.py``) can assert the O(1)
+    host-fetch contract by monkeypatching a single seam.
+    """
+    return jax.device_get(tree)
+
+
+def _chunk_groups(batches, k: int):
+    """Group consecutive batches into lists of ≤ k with UNIFORM leading
+    length, cutting early when the batch size changes (the un-padded
+    stat-collection stream ends with a ragged tail that must become its
+    own dispatch — stacking it with full batches cannot compile)."""
+    buf = []
+    for b in batches:
+        if buf and (
+            len(buf) == k or b[0].shape[0] != buf[0][0].shape[0]
+        ):
+            yield buf
+            buf = []
+        buf.append(b)
+    if buf:
+        yield buf
+
+
+def _stack_eval_chunk(group):
+    """``[(x, y, mask), ...] -> {"x": [k, N, ...], "y": [k, N],
+    "mask": [k, N]}`` — the accumulating eval step's input layout."""
+    xs, ys, ms = zip(*group)
+    return {
+        "x": np.stack([np.asarray(x, np.float32) for x in xs]),
+        "y": np.stack([np.asarray(y) for y in ys]),
+        "mask": np.stack([np.asarray(m, bool) for m in ms]),
+    }
+
+
+class EvalPipeline:
+    """One per training run: compiled eval/stat dispatches + placement.
+
+    ``build_model(axis_name=...)`` is the loops' model factory;
+    ``mesh=None`` is the single-device pipeline (still scanned, device-
+    resident, prefetched), a mesh turns on sharding.  ``num_domains``
+    enables :meth:`collect_stats` (the OfficeHome protocol); digits runs
+    leave it None.
+    """
+
+    def __init__(
+        self,
+        build_model,
+        test_batch_size: int,
+        *,
+        mesh=None,
+        num_domains: Optional[int] = None,
+        eval_k: int = 1,
+        num_workers: int = 0,
+        prefetch_size: int = 2,
+    ):
+        self.test_batch_size = int(test_batch_size)
+        self.eval_k = max(1, int(eval_k))
+        self.num_workers = num_workers
+        self.prefetch_size = prefetch_size
+        self._mesh = mesh
+        self._procs = jax.process_count()
+        self.last_host_fetches = 0  # evidence stream for the bench/tests
+        self._warned_unsharded_collect = False
+
+        model_free = build_model(axis_name=None)  # axis-free twin
+        if mesh is not None:
+            from dwt_tpu.parallel import (
+                make_sharded_collect_step,
+                make_sharded_eval_step,
+                shard_batch,
+            )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = tuple(mesh.axis_names)
+            devices = mesh.size
+            if devices % self._procs != 0:
+                raise ValueError(
+                    f"mesh of {devices} devices cannot split over "
+                    f"{self._procs} processes"
+                )
+            # Eval-mode forwards are per-sample (running stats, no batch
+            # moments), so the global eval batch may be rounded UP to the
+            # device count — masked padding keeps the counters exact and
+            # the reference accuracies unchanged.
+            self._eval_bs = -(-self.test_batch_size // devices) * devices
+            self._replicated = NamedSharding(mesh, P())
+            self._transfer = lambda c: shard_batch(c, mesh, chunked=True)
+            # Counter psum rides the mesh axes; the model stays axis-free
+            # (no train-mode moments on the eval path).
+            self._eval_fn = make_sharded_eval_step(
+                make_accum_eval_step(model_free, axis_name=axis), mesh
+            )
+            if num_domains is not None:
+                # Collect IS a train-mode forward: the sharded step needs
+                # the mesh-axis model so norm sites pmean their moments
+                # into global-batch statistics (1-D meshes use the bare
+                # axis name, matching the train path's convention).
+                model_dp = build_model(
+                    axis_name=axis if len(axis) > 1 else axis[0]
+                )
+                self._collect_sharded = make_sharded_collect_step(
+                    make_scanned_collect(
+                        make_stat_collection_step(model_dp, num_domains)
+                    ),
+                    mesh,
+                )
+        else:
+            self._eval_bs = self.test_batch_size
+            self._replicated = None
+            self._transfer = jax.device_put
+            self._eval_fn = jax.jit(make_accum_eval_step(model_free))
+        if num_domains is not None:
+            self._collect_scanned = jax.jit(
+                make_scanned_collect(
+                    make_stat_collection_step(model_free, num_domains)
+                )
+            )
+            # Axis-free tail step: the ragged final stat batch runs
+            # unsharded (replicated under a mesh) — bitwise the unsharded
+            # path's update, and identical on every process.
+            self._collect_tail = jax.jit(
+                make_stat_collection_step(model_free, num_domains)
+            )
+
+    # ------------------------------------------------------------- eval
+
+    def _shard(self) -> Optional[tuple]:
+        """Per-process slice spec: multi-host runs split every batch (DP)
+        or the test set (legacy single-device path) across processes."""
+        if self._procs > 1:
+            return (jax.process_index(), self._procs)
+        return None
+
+    def _place(self, tree):
+        """Replicate host values over the mesh (or default device).  On
+        multi-host meshes plain ``device_put`` cannot address remote
+        devices; the global-array assembly path replicates instead."""
+        if self._replicated is None:
+            return jax.device_put(tree)
+        if self._procs == 1:
+            return jax.device_put(tree, self._replicated)
+        return jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(
+                self._replicated, np.asarray(a)
+            ),
+            tree,
+        )
+
+    def evaluate(self, state, dataset) -> dict:
+        """Accumulate eval counters over ``dataset``; one host fetch.
+
+        Returns the reference ``test()`` quantities (loss, accuracy %,
+        count) plus the phase's wall time and throughput — the metrics
+        stream's evidence that the pipelined path holds.
+        """
+        t0 = time.perf_counter()
+        self.last_host_fetches = 0  # counted below, not asserted by fiat
+        local_bs = self._eval_bs // (self._procs if self._mesh is not None
+                                     else 1)
+        stream = batch_iterator(
+            dataset,
+            local_bs,
+            shuffle=False,
+            drop_last=False,
+            shard=self._shard(),
+            num_workers=self.num_workers,
+            pad_and_mask=True,
+        )
+        counters = self._place(eval_counters())
+        batches = prefetch_to_device(
+            (_stack_eval_chunk(g) for g in _chunk_groups(stream, self.eval_k)),
+            size=self.prefetch_size,
+            transfer=self._transfer,
+        )
+        try:
+            for chunk in batches:
+                counters = self._eval_fn(
+                    counters, state.params, state.batch_stats, chunk
+                )
+        finally:
+            batches.close()
+        vals = _fetch(counters)  # the pass's ONE device→host sync
+        self.last_host_fetches += 1
+        loss_sum = float(vals["loss_sum"])
+        correct = int(vals["correct"])
+        count = int(vals["count"])
+        if self._mesh is None and self._procs > 1:
+            # Legacy multi-host split without a mesh: each process
+            # evaluated a disjoint subset; sum the counters (still O(1)
+            # host work — one tiny collective per PASS, not per batch).
+            from jax.experimental import multihost_utils
+
+            sums = multihost_utils.process_allgather(
+                np.asarray([loss_sum, float(correct), float(count)])
+            ).sum(axis=0)
+            self.last_host_fetches += 1  # the gather is a 2nd rendezvous
+            loss_sum, correct, count = (
+                float(sums[0]), int(sums[1]), int(sums[2])
+            )
+        seconds = time.perf_counter() - t0
+        return {
+            "loss": loss_sum / max(count, 1),
+            "accuracy": 100.0 * correct / max(count, 1),
+            "count": count,
+            "eval_s": round(seconds, 3),
+            "eval_imgs_per_s": round(count / max(seconds, 1e-9), 1),
+        }
+
+    # -------------------------------------------------- stat collection
+
+    def _load_tail(self, dataset, start: int, stop: int, seed, epoch):
+        """The final ragged stat batch, loaded IN FULL by every process
+        (it is < one global batch) with the same per-item seed tokens the
+        sharded stream uses — augmentation streams stay identical to the
+        unsharded path's."""
+        items = []
+        for i in range(start, stop):
+            item = _load_item(dataset, i, (seed, epoch, int(i)))
+            if item is not QUARANTINED:
+                items.append(item)
+        if not items:
+            return None
+        return np.stack([np.asarray(it[0], np.float32) for it in items])
+
+    def collect_stats(self, state, dataset, *, seed: int = 0, epoch: int = 0):
+        """One full stat-collection pass (reference
+        ``eval_pass_collect_stats``): gradient-free train-mode forwards
+        advancing only ``batch_stats``, scanned k-per-dispatch, sharded
+        over the mesh when the reference batch size splits evenly across
+        it.  On a healthy data path the batch composition is EXACTLY the
+        unsharded reference's (no padding, ragged tail unsharded), so
+        the collected statistics match to reassociation tolerance.
+
+        Caveat: a QUARANTINED item perturbs that parity — the loader's
+        sharded stream substitutes a duplicate into the batch (and the
+        single-process drop shifts later batch boundaries), so the
+        affected batches' moments differ slightly from the
+        drop-one-item unsharded oracle.  Collection batches carry no
+        mask by design (a mask cannot be threaded through the models'
+        norm-site moments), and stats are EMA-smoothed over
+        ``stat_collection_passes × B`` batches, so a rare bad item moves
+        the estimate negligibly — but bit-parity claims only hold with
+        zero quarantines.
+        """
+        if not hasattr(self, "_collect_scanned"):
+            raise RuntimeError(
+                "EvalPipeline was built without num_domains; stat "
+                "collection is an OfficeHome-recipe phase"
+            )
+        bs = self.test_batch_size
+        n = len(dataset)
+        sharded = (
+            self._mesh is not None
+            and bs % self._mesh.size == 0
+            and n >= bs
+        )
+        if self._mesh is not None and not sharded and n >= bs:
+            if not self._warned_unsharded_collect:
+                self._warned_unsharded_collect = True
+                log.warning(
+                    "stat collection runs unsharded: --test_batch_size "
+                    "%d does not split over the %d-device mesh (padding "
+                    "would perturb the collected moments); eval itself "
+                    "stays sharded",
+                    bs, self._mesh.size,
+                )
+        if sharded:
+            usable = n - n % bs
+            local_bs = bs // self._procs
+            stream = batch_iterator(
+                dataset, local_bs, shuffle=False, drop_last=True,
+                seed=seed, epoch=epoch, shard=self._shard(),
+                num_workers=self.num_workers,
+            )
+            chunks = (
+                np.stack([np.asarray(b[0], np.float32) for b in g])
+                for g in _chunk_groups(stream, self.eval_k)
+            )
+            batches = prefetch_to_device(
+                chunks, size=self.prefetch_size, transfer=self._transfer
+            )
+            try:
+                for xs in batches:
+                    state = self._collect_sharded(state, xs)
+            finally:
+                batches.close()
+            if usable < n:
+                tail = self._load_tail(dataset, usable, n, seed, epoch)
+                if tail is not None:
+                    state = self._collect_tail(state, self._place(tail))
+            return state
+        # Unsharded (or tiny-dataset) pipeline: still scanned, prefetched,
+        # device-resident; the ragged tail cuts into its own dispatch.
+        stream = batch_iterator(
+            dataset, bs, shuffle=False, drop_last=False,
+            seed=seed, epoch=epoch, num_workers=self.num_workers,
+        )
+        chunks = (
+            np.stack([np.asarray(b[0], np.float32) for b in g])
+            for g in _chunk_groups(stream, self.eval_k)
+        )
+        batches = prefetch_to_device(
+            chunks, size=self.prefetch_size, transfer=self._place,
+        )
+        try:
+            for xs in batches:
+                state = self._collect_scanned(state, xs)
+        finally:
+            batches.close()
+        return state

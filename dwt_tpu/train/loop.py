@@ -70,13 +70,12 @@ from dwt_tpu.train.optim import (
     officehome_tx,
     with_lr_backoff,
 )
+from dwt_tpu.train.evalpipe import EvalPipeline
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
     make_digits_train_step,
-    make_eval_step,
     make_officehome_train_step,
     make_scanned_step,
-    make_stat_collection_step,
     stack_batches,
 )
 from dwt_tpu.utils import (
@@ -130,8 +129,9 @@ def _maybe_init_distributed(cfg) -> None:
     ``jax.distributed.initialize`` auto-detects coordinator/rank on Cloud
     TPU; each process then loads its own 1/process_count shard of every
     epoch (``batch_iterator(shard=...)``), the global batch is assembled by
-    ``shard_batch`` via ``make_array_from_process_local_data``, and eval
-    counters are summed across processes in ``_evaluate``.
+    ``shard_batch`` via ``make_array_from_process_local_data``, and the
+    eval/stat pipeline (``EvalPipeline``) shards its batches the same way
+    with counters ``psum``'d over the mesh.
     """
     if not getattr(cfg, "distributed", False):
         return
@@ -210,16 +210,10 @@ def _multihost_data_split(cfg, bs: int) -> Tuple[int, Optional[Tuple[int, int]]]
     return bs // n, (jax.process_index(), n)
 
 
-def _process_shard() -> Optional[Tuple[int, int]]:
-    """This process's eval ``shard=`` (multi-host test-set split), or None."""
-    if jax.process_count() > 1:
-        return (jax.process_index(), jax.process_count())
-    return None
-
-
 def _maybe_dp(cfg, step_fn_builder, model_kw):
-    """Build ``(model, wrap_step, wrap_batch, (make_chunked, wrap_chunk))``
-    for single-device or DP runs.
+    """Build ``(model, wrap_step, wrap_batch, (make_chunked, wrap_chunk),
+    mesh)`` for single-device or DP runs (``mesh`` is None off the DP
+    path; the eval/stat pipeline shards over the same mesh).
 
     ``make_chunked(raw_step, k)`` compiles a k-steps-per-dispatch variant
     (lax.scan over ``[k, batch, ...]`` chunks) and ``wrap_chunk`` places a
@@ -251,7 +245,10 @@ def _maybe_dp(cfg, step_fn_builder, model_kw):
         make_chunked = lambda fn, k: jax.jit(
             make_scanned_step(fn, k), donate_argnums=0
         )
-        return model, jax.jit, jax.device_put, (make_chunked, jax.device_put)
+        return (
+            model, jax.jit, jax.device_put, (make_chunked, jax.device_put),
+            None,
+        )
     from dwt_tpu.parallel import (
         DATA_AXIS,
         DCN_AXIS,
@@ -281,7 +278,10 @@ def _maybe_dp(cfg, step_fn_builder, model_kw):
     wrap = lambda fn: make_sharded_train_step(fn, mesh)
     make_chunked = lambda fn, k: make_sharded_scanned_step(fn, mesh, k)
     wrap_chunk = lambda c: shard_batch(c, mesh, chunked=True)
-    return model, wrap, lambda b: shard_batch(b, mesh), (make_chunked, wrap_chunk)
+    return (
+        model, wrap, lambda b: shard_batch(b, mesh),
+        (make_chunked, wrap_chunk), mesh,
+    )
 
 
 def _chunk_stream(batches, k: int, should_cut=None, start: int = 0):
@@ -365,6 +365,14 @@ def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
     )
 
 
+# Consensus decision records ("consensus" kind) aggregate this many
+# decide() calls per emitted line: every boundary would drown the JSONL
+# stream at steps_per_dispatch=1, while one line per N keeps the latency
+# of the per-boundary allgather — a real per-step cost on DCN-connected
+# hosts — continuously visible (ROADMAP observability item).
+_CONSENSUS_LOG_EVERY = 50
+
+
 class _StepBoundary:
     """Everything the loops must do once per step/chunk boundary, fused
     into one call: the step-indexed control-fault hooks, the watchdog
@@ -380,12 +388,33 @@ class _StepBoundary:
     after leaving the step loop.
     """
 
-    def __init__(self, guard, preempt, coord, watchdog):
+    def __init__(self, guard, preempt, coord, watchdog, logger=None):
         self.guard = guard
         self.preempt = preempt
         self.coord = coord
         self.watchdog = watchdog
+        self.logger = logger
         self.stop = False
+        self._decides_logged = 0
+
+    def _log_consensus(self, gstep: int) -> None:
+        """Aggregate consensus-latency record every N decides."""
+        c = self.coord
+        if (
+            self.logger is None
+            or c.decides == 0
+            or c.decides - self._decides_logged < _CONSENSUS_LOG_EVERY
+        ):
+            return
+        self._decides_logged = c.decides
+        self.logger.log(
+            "consensus",
+            gstep,
+            decides=c.decides,
+            last_s=round(c.last_decide_s, 6),
+            mean_s=round(c.total_decide_s / c.decides, 6),
+            max_s=round(c.max_decide_s, 6),
+        )
 
     def __call__(self, state, metrics, n_steps: int, gstep: int):
         self.watchdog.heartbeat()
@@ -415,6 +444,7 @@ class _StepBoundary:
                     event.step if isinstance(event, RollbackRequest) else -1
                 ),
             )
+            self._log_consensus(gstep)
             self.stop = self.stop or decision.stop
             if event is not None:
                 raise event  # every host now knows; act on the local event
@@ -655,42 +685,20 @@ def _read_best_record(ckpt_dir: Optional[str]) -> float:
         return -1.0
 
 
-def _evaluate(
-    eval_step,
-    state: TrainState,
-    dataset,
-    batch_size: int,
-    num_workers: int = 0,
-) -> dict:
-    """Accumulate eval counters; multi-host runs shard the test set per
-    process and sum the counters across processes (the cross-replica sum
-    of the reference ``test()`` accumulators, SURVEY §5)."""
-    loss_sum, correct, count = 0.0, 0, 0
-    # Prefetch overlaps host batch assembly + transfer with the device's
-    # previous eval step (same double-buffering as the train loops).
-    for x, y in prefetch_to_device(
-        batch_iterator(
-            dataset, batch_size, shuffle=False, drop_last=False,
-            shard=_process_shard(), num_workers=num_workers,
-        ),
-        size=2,
-    ):
-        out = eval_step(state.params, state.batch_stats, x, y)
-        loss_sum += float(out["loss_sum"])
-        correct += int(out["correct"])
-        count += int(out["count"])
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        sums = multihost_utils.process_allgather(
-            np.asarray([loss_sum, float(correct), float(count)])
-        ).sum(axis=0)
-        loss_sum, correct, count = float(sums[0]), int(sums[1]), int(sums[2])
-    return {
-        "loss": loss_sum / max(count, 1),
-        "accuracy": 100.0 * correct / max(count, 1),
-        "count": count,
-    }
+def _make_eval_pipeline(cfg, build_model, mesh, num_domains=None) -> EvalPipeline:
+    """The run's eval/stat fast path (ISSUE-4): device-resident counters
+    (O(1) host fetches per pass), ``--eval_steps_per_dispatch`` scanned
+    dispatch, prefetch at the training staging depth, and — when
+    ``--data_parallel`` is on — batches sharded over the same mesh as the
+    train step (composed with the per-process multi-host split)."""
+    return EvalPipeline(
+        build_model,
+        cfg.test_batch_size,
+        mesh=mesh,
+        num_domains=num_domains,
+        eval_k=max(1, getattr(cfg, "eval_steps_per_dispatch", 1)),
+        num_workers=cfg.num_workers,
+    )
 
 
 # ------------------------------------------------------------------ digits
@@ -782,7 +790,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             use_pallas=cfg.pallas_whiten,
         )
 
-    model, wrap, wrap_batch, (make_chunked, wrap_chunk) = _maybe_dp(
+    model, wrap, wrap_batch, (make_chunked, wrap_chunk), mesh = _maybe_dp(
         cfg, build_model, {}
     )
     sample = jnp.zeros((2, bs, 28, 28, 1), jnp.float32)
@@ -816,17 +824,14 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         axis_name=getattr(model, "axis_name", None),
     )
     train_step = wrap(raw_step)
-    eval_step = jax.jit(make_eval_step(build_model(axis_name=None)))
+    evalp = _make_eval_pipeline(cfg, build_model, mesh)
     k_dispatch = max(1, cfg.steps_per_dispatch)
     chunk_fns = {}  # chunk length -> compiled scanned step
 
     if start_epoch >= cfg.epochs:
         # Resumed from a finished run: report the restored model's accuracy
         # instead of silently returning 0.0 without evaluating.
-        result = _evaluate(
-            eval_step, state, target_test_ds, cfg.test_batch_size,
-            num_workers=cfg.num_workers,
-        )
+        result = evalp.evaluate(state, target_test_ds)
         logger.log("test", int(state.step), epoch=start_epoch, **result)
         logger.log(
             "params_digest", int(state.step), digest=_params_digest(state)
@@ -854,7 +859,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         # thread; errors were already logged and must not mask the
         # original exception.  Normal paths flush explicitly first.
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
-        boundary = _StepBoundary(guard, preempt, coord, wd)
+        boundary = _StepBoundary(guard, preempt, coord, wd, logger)
         while epoch < cfg.epochs:
             source_iter = batch_iterator(
                 source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
@@ -995,10 +1000,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         ckpt.flush()
                 logger.log("preempt", int(state.step), epoch=epoch, sync=True)
                 return acc
-            result = _evaluate(
-                eval_step, state, target_test_ds, cfg.test_batch_size,
-                num_workers=cfg.num_workers,
-            )
+            result = evalp.evaluate(state, target_test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), epoch=epoch, **result)
@@ -1122,7 +1124,7 @@ def run_officehome(
             remat=cfg.remat,
         )
 
-    model, wrap, wrap_batch, (make_chunked, wrap_chunk) = _maybe_dp(
+    model, wrap, wrap_batch, (make_chunked, wrap_chunk), mesh = _maybe_dp(
         cfg, build_model, {}
     )
     size = cfg.img_crop_size
@@ -1188,9 +1190,7 @@ def run_officehome(
         axis_name=getattr(model, "axis_name", None),
     )
     train_step = wrap(raw_step)
-    eval_model = build_model(axis_name=None)
-    eval_step = jax.jit(make_eval_step(eval_model))
-    collect_step = jax.jit(make_stat_collection_step(eval_model, num_domains=3))
+    evalp = _make_eval_pipeline(cfg, build_model, mesh, num_domains=3)
 
     acc = 0.0
     ckpt = _CkptPipeline(cfg)
@@ -1210,10 +1210,7 @@ def run_officehome(
         # these indices so the cadences match the per-step loop.
         nonlocal acc, best_acc, state
         if (it + 1) % cfg.check_acc_step == 0:
-            result = _evaluate(
-                eval_step, state, test_ds, cfg.test_batch_size,
-                num_workers=cfg.num_workers,
-            )
+            result = evalp.evaluate(state, test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), iter=it, **result)
@@ -1268,7 +1265,7 @@ def run_officehome(
     ) as wd:
         # Abnormal-exit rendezvous for the async writer (see run_digits).
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
-        boundary = _StepBoundary(guard, preempt, coord, wd)
+        boundary = _StepBoundary(guard, preempt, coord, wd, logger)
         # Rollback retry loop: each attempt builds fresh (re-seeded)
         # streams and trains from the current state; a RollbackRequest
         # restores the newest valid checkpoint and starts a new attempt.
@@ -1427,24 +1424,27 @@ def run_officehome(
 
     # Post-training protocol: N gradient-free train-mode passes over the
     # target TEST set with tripled data to re-estimate target stats
-    # (resnet50…py:380-389), then the final test.
+    # (resnet50…py:380-389), then the final test.  Routed through the
+    # eval pipeline: scanned k-batches-per-dispatch, prefetched, and —
+    # under --data_parallel — sharded over the mesh with moments pmean'd
+    # (each pass is ~a full dataset forward; with 10 passes + the final
+    # eval this phase is ~11 dataset passes, the dominant eval-cadence
+    # cost the pipeline exists to cut).
     for p in range(cfg.stat_collection_passes):
         # seed/epoch vary the per-item augmentation tokens so each pass
         # draws fresh crops — N identical passes would defeat the
         # stat-re-estimation protocol (resnet50…py:380-389).
-        for x, _ in prefetch_to_device(
-            batch_iterator(
-                test_ds, cfg.test_batch_size, shuffle=False, drop_last=False,
-                seed=cfg.seed, epoch=p, num_workers=cfg.num_workers,
-            ),
-            size=2,
+        with logger.timed(
+            "stat_collection", int(state.step), pass_index=p,
+            imgs=len(test_ds),
         ):
-            state = collect_step(state, x)
-        logger.log("stat_collection", int(state.step), pass_index=p)
-    result = _evaluate(
-        eval_step, state, test_ds, cfg.test_batch_size,
-        num_workers=cfg.num_workers,
-    )
+            state = evalp.collect_stats(
+                state, test_ds, seed=cfg.seed, epoch=p
+            )
+            # The pass dispatches asynchronously; settle before stamping
+            # the wall time so the record measures work, not enqueueing.
+            jax.block_until_ready(jax.tree.leaves(state.batch_stats))
+    result = evalp.evaluate(state, test_ds)
     acc = result["accuracy"]
     logger.log("final_test", int(state.step), **result)
     logger.log("params_digest", int(state.step), digest=_params_digest(state))

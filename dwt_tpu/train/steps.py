@@ -246,6 +246,88 @@ def make_eval_step(
     return eval_step
 
 
+def eval_counters() -> Metrics:
+    """Zero-initialized device-resident eval accumulators.
+
+    The reference ``test()`` functions accumulate sum-loss / correct /
+    count over the whole test set (``usps_mnist.py:310-327``); the fast
+    eval path keeps exactly those three scalars ON DEVICE across every
+    batch and fetches them once at the end of the pass.
+    """
+    return {
+        "loss_sum": jnp.zeros((), jnp.float32),
+        "correct": jnp.zeros((), jnp.int32),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def make_accum_eval_step(
+    model, axis_name: Optional[AxisName] = None
+) -> Callable[[Metrics, Any, Any, Dict[str, jax.Array]], Metrics]:
+    """Accumulating, scanned eval dispatch: ``(counters, params, stats,
+    chunk) -> counters``.
+
+    ``chunk`` stacks k batches — ``{"x": [k, N, ...], "y": [k, N],
+    "mask": [k, N] bool}`` — and the scan threads the counter carry
+    through all k batches inside ONE compiled program, so a full eval
+    pass costs ``ceil(B/k)`` dispatches and O(1) host fetches instead of
+    one blocking ``float()`` per batch (the ``--eval_steps_per_dispatch``
+    machinery; the train-path analogue is :func:`make_scanned_step`).
+
+    ``mask`` marks real samples: the loader pads ragged final batches to
+    a uniform shape (``batch_iterator(pad_and_mask=True)``) so every
+    dispatch compiles once, and padded rows contribute nothing to any
+    counter — counts stay exact.  With ``axis_name`` the chunk's counter
+    deltas are ``psum``'d across replicas ONCE per dispatch (not per
+    inner batch), which makes the same function the per-replica body for
+    ``shard_map`` (``parallel.make_sharded_eval_step``).
+    """
+
+    def accum_eval(counters, params, batch_stats, chunk):
+        def body(c, b):
+            logits = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                b["x"],
+                train=False,
+            )
+            logp = jax.nn.log_softmax(at_least_f32(logits), axis=-1)
+            per_sample = nll_loss(logp, b["y"], reduction="none")
+            mask = b["mask"]
+            hit = (jnp.argmax(logits, axis=-1) == b["y"]) & mask
+            delta = {
+                "loss_sum": jnp.sum(jnp.where(mask, per_sample, 0.0)),
+                "correct": jnp.sum(hit.astype(jnp.int32)),
+                "count": jnp.sum(mask.astype(jnp.int32)),
+            }
+            return jax.tree.map(jnp.add, c, delta), None
+
+        zeros = jax.tree.map(jnp.zeros_like, counters)
+        total, _ = lax.scan(body, zeros, chunk)
+        if axis_name is not None:
+            total = lax.psum(total, axis_name)
+        return jax.tree.map(jnp.add, counters, total)
+
+    return accum_eval
+
+
+def make_scanned_collect(
+    collect_fn: Callable[[TrainState, jax.Array], TrainState],
+) -> Callable[[TrainState, jax.Array], TrainState]:
+    """Scan a stat-collection step over ``xs [k, N, ...]`` — k collection
+    batches per dispatch, state (the ``batch_stats`` EMA carry) resident
+    on device across all of them.  Numerics are the per-batch path's:
+    the body IS ``collect_fn``; only the dispatch granularity changes."""
+
+    def scanned(state: TrainState, xs: jax.Array) -> TrainState:
+        def body(s, x):
+            return collect_fn(s, x), None
+
+        state, _ = lax.scan(body, state, xs)
+        return state
+
+    return scanned
+
+
 def make_stat_collection_step(
     model, num_domains: int
 ) -> Callable[[TrainState, jax.Array], TrainState]:

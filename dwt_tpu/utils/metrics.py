@@ -7,6 +7,7 @@ and a machine-parseable JSON record, to stdout and optionally a JSONL file.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -47,6 +48,27 @@ class MetricLogger:
             self._file.flush()
             if sync:
                 os.fsync(self._file.fileno())
+
+    @contextlib.contextmanager
+    def timed(self, kind: str, step: int, **values):
+        """Log one record with the block's wall time as ``seconds``.
+
+        The observability seam for whole phases (stat-collection passes,
+        anything without a natural per-item record): callers that need a
+        rate pair the emitted ``seconds`` with a count field (e.g.
+        ``imgs=...``).  The record is emitted on exit even when the block
+        raises — a phase that died half-way is exactly when its elapsed
+        time matters for the post-mortem.
+        """
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.log(
+                kind, step,
+                seconds=round(time.perf_counter() - t0, 3),
+                **values,
+            )
 
     def close(self) -> None:
         if self._file:

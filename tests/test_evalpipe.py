@@ -1,0 +1,313 @@
+"""ISSUE-4 eval/stat-collection pipeline: sharded-vs-unsharded parity and
+the O(1)-host-fetch contract.
+
+The invariants, mirroring what ``tests/test_parallel.py`` pins for the
+train step:
+
+* data-parallel eval produces IDENTICAL correct/count counters (exact
+  ints — masked padding keeps ragged tails exact) and loss within float
+  tolerance of the naive per-batch path;
+* sharded stat collection reproduces the unsharded stats trajectory to
+  the train step's reassociation tolerance, including an uneven final
+  batch (which runs through the axis-free tail step);
+* a full eval pass performs O(1) host fetches (counting shim on the
+  module's single fetch seam).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.data import ArrayDataset, batch_iterator
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.parallel import make_mesh, replicate_state
+from dwt_tpu.train import (
+    EvalPipeline,
+    adam_l2,
+    create_train_state,
+    make_digits_train_step,
+    make_eval_step,
+    make_stat_collection_step,
+)
+from dwt_tpu.train import evalpipe
+
+
+def _dataset(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    y = (rng.integers(0, 10, size=(n,))).astype(np.int64)
+    return ArrayDataset(x, y)
+
+
+def _build(axis_name=None):
+    return LeNetDWT(group_size=4, axis_name=axis_name)
+
+
+@pytest.fixture(scope="module")
+def trained_state():
+    """One real train step so running stats/params are non-trivial."""
+    tx = adam_l2(1e-3)
+    model = _build()
+    rng = np.random.default_rng(7)
+    sx = jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32)
+    txi = jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.stack([sx, txi]), tx
+    )
+    step = jax.jit(make_digits_train_step(model, tx, 0.1))
+    state, _ = step(
+        state,
+        {
+            "source_x": sx,
+            "source_y": jnp.asarray(rng.integers(0, 10, size=(8,))),
+            "target_x": txi,
+        },
+    )
+    return state
+
+
+def _naive_eval(state, dataset, batch_size):
+    """The pre-ISSUE-4 eval loop: one dispatch + one host sync per batch,
+    ragged tail as its own shape.  The parity oracle."""
+    eval_step = jax.jit(make_eval_step(_build()))
+    loss_sum, correct, count = 0.0, 0, 0
+    for x, y in batch_iterator(
+        dataset, batch_size, shuffle=False, drop_last=False
+    ):
+        out = eval_step(state.params, state.batch_stats, x, y)
+        loss_sum += float(out["loss_sum"])
+        correct += int(out["correct"])
+        count += int(out["count"])
+    return loss_sum, correct, count
+
+
+def _naive_collect(state, dataset, batch_size, num_domains, passes=1):
+    """The pre-ISSUE-4 stat-collection loop: per-batch dispatch, ragged
+    tail included, sequential order."""
+    collect = jax.jit(make_stat_collection_step(_build(), num_domains))
+    for p in range(passes):
+        for x, _ in batch_iterator(
+            dataset, batch_size, shuffle=False, drop_last=False, epoch=p
+        ):
+            state = collect(state, jnp.asarray(x))
+    return state
+
+
+def _assert_tree_close(a_tree, b_tree, rtol, atol):
+    for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol
+        )
+
+
+# ----------------------------------------------------------- loader level
+
+
+def test_pad_and_mask_uniform_batches_exact_counts():
+    ds = _dataset(52)
+    batches = list(
+        batch_iterator(ds, 8, shuffle=False, drop_last=False,
+                       pad_and_mask=True)
+    )
+    # 52 items, bs 8 -> 7 batches, ALL of them full-shape.
+    assert len(batches) == 7
+    for x, y, m in batches:
+        assert x.shape == (8, 28, 28, 1) and m.shape == (8,)
+        assert m.dtype == np.bool_
+    # Mask bits cover each real item exactly once.
+    assert sum(int(m.sum()) for _, _, m in batches) == 52
+    # The tail batch is padded with copies of the final item, masked out.
+    x, y, m = batches[-1]
+    assert list(m) == [True] * 4 + [False] * 4
+    np.testing.assert_array_equal(x[4], x[5])
+
+
+def test_pad_and_mask_sharded_equal_batch_counts():
+    ds = _dataset(52)
+    count = 4
+    per_shard = [
+        list(batch_iterator(ds, 4, shuffle=False, drop_last=False,
+                            pad_and_mask=True, shard=(i, count)))
+        for i in range(count)
+    ]
+    # Every shard yields the SAME number of identically-shaped batches —
+    # the collective eval step's no-deadlock invariant.
+    lens = {len(b) for b in per_shard}
+    assert lens == {4}  # 52 -> padded to 64 = 4 shards * 4 batches * 4
+    # The union of masked-real samples is each item exactly once.
+    real = sum(
+        int(m.sum()) for shard in per_shard for _, _, m in shard
+    )
+    assert real == 52
+
+
+def test_pad_and_mask_rejects_training_semantics():
+    ds = _dataset(8)
+    with pytest.raises(ValueError, match="pad_and_mask"):
+        next(iter(batch_iterator(ds, 4, shuffle=True, pad_and_mask=True)))
+
+
+# ------------------------------------------------------------- eval parity
+
+
+def test_eval_pipeline_matches_naive_and_fetches_once(
+    trained_state, monkeypatch
+):
+    ds = _dataset(52)  # uneven tail: 6 full batches + 4
+    want = _naive_eval(trained_state, ds, 8)
+
+    fetches = []
+    real_fetch = evalpipe._fetch
+    monkeypatch.setattr(
+        evalpipe, "_fetch", lambda t: fetches.append(1) or real_fetch(t)
+    )
+    pipe = EvalPipeline(_build, 8, eval_k=3)
+    result = pipe.evaluate(trained_state, ds)
+    # O(1) host fetches for the WHOLE pass (7 batches, 3 dispatches).
+    assert len(fetches) == 1
+    assert pipe.last_host_fetches == 1
+    assert result["count"] == want[2] == 52
+    assert result["accuracy"] == pytest.approx(100.0 * want[1] / want[2])
+    assert result["loss"] == pytest.approx(want[0] / want[2], rel=1e-5)
+    assert result["eval_s"] > 0
+
+
+@pytest.mark.parametrize(
+    "batch_size",
+    [8, pytest.param(12, marks=pytest.mark.slow)],
+)
+def test_sharded_eval_exact_counter_parity(trained_state, batch_size):
+    """8-way DP eval must produce the naive path's counters EXACTLY —
+    including the uneven final batch and (bs=12, slow tier for the 870 s
+    budget) a batch size that does not divide over the mesh (rounded up
+    + masked, counters unchanged)."""
+    assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
+    mesh = make_mesh(jax.devices()[:8])
+    ds = _dataset(52, seed=3)
+    want_loss, want_correct, want_count = _naive_eval(
+        trained_state, ds, batch_size
+    )
+    pipe = EvalPipeline(_build, batch_size, mesh=mesh, eval_k=2)
+    state_r = replicate_state(trained_state, mesh)
+    result = pipe.evaluate(state_r, ds)
+    assert result["count"] == want_count == 52
+    assert result["accuracy"] == pytest.approx(
+        100.0 * want_correct / want_count
+    )
+    assert result["loss"] == pytest.approx(
+        want_loss / want_count, rel=1e-5
+    )
+
+
+# -------------------------------------------------- stat-collection parity
+
+
+def test_unsharded_scanned_collect_matches_per_batch(trained_state):
+    ds = _dataset(20, seed=5)  # 2 full batches + ragged 4
+    want = _naive_collect(trained_state, ds, 8, num_domains=2)
+    pipe = EvalPipeline(_build, 8, num_domains=2, eval_k=4)
+    got = pipe.collect_stats(trained_state, ds)
+    # Same math, different dispatch granularity: scan-body fusion may
+    # reassociate float reductions (the make_scanned_step caveat).
+    _assert_tree_close(got.batch_stats, want.batch_stats, 1e-6, 1e-6)
+    _assert_tree_close(got.params, want.params, 0.0, 0.0)
+
+
+def test_sharded_collect_parity_uneven_tail(trained_state):
+    """DP stat collection must reproduce the unsharded stats trajectory
+    (train-step tolerance): full batches sharded with moments pmean'd,
+    the ragged tail through the axis-free step."""
+    assert jax.device_count() >= 8
+    mesh = make_mesh(jax.devices()[:8])
+    ds = _dataset(20, seed=9)
+    want = _naive_collect(trained_state, ds, 8, num_domains=2)
+    pipe = EvalPipeline(_build, 8, mesh=mesh, num_domains=2, eval_k=2)
+    got = pipe.collect_stats(replicate_state(trained_state, mesh), ds)
+    # Same bars as tests/test_parallel.py holds the sharded train step
+    # to: reduction-order noise through the whitening chain, not drift.
+    _assert_tree_close(got.batch_stats, want.batch_stats, 1e-5, 2e-5)
+
+
+@pytest.mark.slow
+def test_sharded_collect_falls_back_when_indivisible(trained_state, caplog):
+    """A batch size that does not split over the mesh must NOT be padded
+    (padding perturbs the moments the protocol estimates) — the pass
+    runs unsharded and still matches the oracle.  Slow tier (870 s
+    budget): the fast tier keeps the divisible sharded parity + the
+    unsharded scan parity; this covers only the fallback routing."""
+    assert jax.device_count() >= 8
+    mesh = make_mesh(jax.devices()[:8])
+    ds = _dataset(15, seed=11)
+    want = _naive_collect(trained_state, ds, 6, num_domains=2)
+    pipe = EvalPipeline(_build, 6, mesh=mesh, num_domains=2, eval_k=2)
+    with caplog.at_level("WARNING"):
+        got = pipe.collect_stats(replicate_state(trained_state, mesh), ds)
+    assert any("unsharded" in r.message for r in caplog.records)
+    _assert_tree_close(got.batch_stats, want.batch_stats, 1e-6, 1e-6)
+
+
+@pytest.mark.slow
+def test_sharded_collect_parity_2d_mesh_multi_pass(trained_state):
+    """Heavier parity matrix: the 2-D (dcn, data) mesh, two passes, a
+    second uneven-tail size — the multi-slice stat-collection twin of
+    test_parallel's 2-D train parity."""
+    assert jax.device_count() >= 8
+    mesh = make_mesh(jax.devices()[:8], dcn_slices=2)
+    ds = _dataset(28, seed=13)
+    want = _naive_collect(trained_state, ds, 8, num_domains=2, passes=2)
+    pipe = EvalPipeline(_build, 8, mesh=mesh, num_domains=2, eval_k=3)
+    got = replicate_state(trained_state, mesh)
+    for p in range(2):
+        got = pipe.collect_stats(got, ds, epoch=p)
+    _assert_tree_close(got.batch_stats, want.batch_stats, 1e-5, 2e-5)
+    # Eval over the 2-D mesh as well, same exactness bar.
+    want_eval = _naive_eval(want, ds, 8)
+    result = pipe.evaluate(got, ds)
+    assert result["count"] == want_eval[2] == 28
+
+
+# ------------------------------------- observability satellites (ISSUE-4)
+
+
+def test_metric_logger_timed_emits_seconds(tmp_path):
+    import json
+
+    from dwt_tpu.utils import MetricLogger
+
+    path = tmp_path / "m.jsonl"
+    logger = MetricLogger(jsonl_path=str(path))
+    with logger.timed("stat_collection", 7, pass_index=2, imgs=12):
+        pass
+    logger.close()
+    rec = json.loads(path.read_text().strip())
+    assert rec["kind"] == "stat_collection" and rec["step"] == 7
+    assert rec["seconds"] >= 0 and rec["pass_index"] == 2
+    # A failing phase still stamps its elapsed time (post-mortem data).
+    logger2 = MetricLogger(jsonl_path=str(path))
+    with pytest.raises(RuntimeError):
+        with logger2.timed("stat_collection", 8):
+            raise RuntimeError("boom")
+    logger2.close()
+    assert json.loads(path.read_text().splitlines()[-1])["step"] == 8
+
+
+def test_coordinator_tracks_decide_latency():
+    """The consensus allgather's latency is accounted per decide — the
+    loops surface it as the "consensus" record kind (ROADMAP
+    observability item).  Forced-enabled single-process mode exercises
+    the real collective path, as in test_distributed."""
+    from dwt_tpu.resilience import Coordinator
+
+    coord = Coordinator(enabled=True)
+    assert coord.decides == 0
+    for _ in range(3):
+        d = coord.decide(stop=False)
+    assert not d.stop and not d.diverged
+    assert coord.decides == 3
+    assert coord.last_decide_s >= 0.0
+    assert coord.total_decide_s >= coord.max_decide_s >= coord.last_decide_s * 0
+    # Disabled (single-process fast path) never touches the accounting.
+    inert = Coordinator()
+    inert.decide(stop=True)
+    assert inert.decides == 0

@@ -34,6 +34,49 @@ needs_native = pytest.mark.skipif(
     not native.available(), reason="native library unavailable (no g++?)"
 )
 
+# The native-vs-cv2 comparisons assume cv2.warpAffine behaves like exact
+# bilinear up to its documented 1/32-px fixed-point coordinate
+# quantization (<~0.02 on the normalized scale).  Some cv2 builds (e.g.
+# this container's headless 4.12) deviate from the float64 golden by 5x
+# that, which makes "native within 0.05 of cv2" unsatisfiable even
+# though the native kernel matches exact math to 1.5e-3 — measured, so
+# the skip reason names the number.  The float64-golden tests below keep
+# pinning the kernel's correctness either way.
+_CV2_GOLDEN_BUDGET = 0.02
+
+
+def _cv2_golden_deviation():
+    """Max |cv2 warp chain − float64 golden| over a few seeded draws, or
+    None when cv2 is absent (transforms fall back to scipy)."""
+    from dwt_tpu.data import transforms
+
+    if not transforms._HAS_CV2:
+        return None
+    a = _img(64, 64, seed=17)
+    rng = np.random.default_rng(17)
+    worst = 0.0
+    for _ in range(3):
+        m = draw_affine_matrix(rng, 0.1)
+        got = (
+            warp_affine(a.astype(np.float32) / 255.0, m) - np.float32(MEAN)
+        ) / np.float32(STD)
+        worst = max(worst, np.abs(got - _golden_warp_norm(a, m, MEAN, STD)).max())
+    return float(worst)
+
+
+def _cv2_comparable():
+    dev = _cv2_golden_deviation()
+    if dev is None:
+        return False, "cv2 unavailable (warp_affine falls back to scipy)"
+    if dev > _CV2_GOLDEN_BUDGET:
+        return False, (
+            f"this cv2 build's warpAffine deviates {dev:.3f} from the "
+            f"float64 bilinear golden (> {_CV2_GOLDEN_BUDGET}): the "
+            "native-vs-cv2 tolerance assumes 1/32-px fixed-point "
+            "behavior; the float64-golden tests pin the native kernel"
+        )
+    return True, ""
+
 
 def _img(h=61, w=53, c=3, seed=0):
     return np.random.default_rng(seed).integers(
@@ -74,6 +117,14 @@ def _golden_warp_norm(a_u8, m, mean, std):
     return (out / 255.0 - np.asarray(mean)) / np.asarray(std)
 
 
+# Evaluated here (not next to its helpers above): the probe needs _img
+# and _golden_warp_norm, defined in between.
+_CV2_OK, _CV2_SKIP_REASON = _cv2_comparable()
+
+needs_comparable_cv2 = pytest.mark.skipif(not _CV2_OK,
+                                          reason=_CV2_SKIP_REASON)
+
+
 @needs_native
 def test_normalize_from_u8_matches_python_chain():
     a = _img()
@@ -103,6 +154,7 @@ def test_warp_norm_matches_float64_golden(sigma):
 
 
 @needs_native
+@needs_comparable_cv2
 def test_warp_norm_close_to_cv2_path():
     a = _img(128, 128)
     rng = np.random.default_rng(7)
@@ -143,6 +195,7 @@ def test_warp_zero_border_normalizes_zero():
 
 
 @needs_native
+@needs_comparable_cv2
 def test_fused_transforms_match_fallback_streams():
     # Same seed: the fused class and the manual unfused chain must draw
     # identical matrices and produce matching outputs (within the cv2
@@ -161,6 +214,13 @@ def test_fused_transforms_match_fallback_streams():
     )
     assert np.abs(got - want).max() < 0.05
 
+
+@needs_native
+def test_fused_normalize_matches_fallback_stream_exact():
+    # Split from the warp comparison above: the normalize fusion is
+    # float32-exact and does not depend on the cv2 build, so it keeps
+    # running where the warp comparison must skip.
+    a = _img(96, 96, seed=5)
     f2 = FusedToArrayNormalize(MEAN, STD)
     np.testing.assert_allclose(
         f2(a), Normalize(MEAN, STD)(ToArray()(a)), atol=1e-6

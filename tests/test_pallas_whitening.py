@@ -199,3 +199,76 @@ def test_pallas_rejects_data_parallel_axis():
     x = jnp.zeros((2, 4, 8))
     with pytest.raises(ValueError, match="single-chip"):
         model.init(jax.random.key(0), x, train=True)
+
+
+# ------------------------------------------------- off-chip TPU lowering
+
+# The kernels only ever COMPILED on a real chip before ISSUE-4 — the
+# interpret-mode parity above proves the math, not that Mosaic accepts
+# the program (VERDICT.md Missing #5: a 3-D batched dot in the original
+# kernels failed TPU lowering, invisibly to CI).  ``jax.export`` can run
+# the full Mosaic lowering pipeline with no TPU attached; these tests pin
+# it at the flagship whitening-site shapes (PERF.md inventory).
+
+_SITES = {
+    # site -> (rows = batch·H·W at the reference 18-image batch, channels)
+    "stem": (18 * 112 * 112, 64),
+    "layer1.bn3": (18 * 56 * 56, 256),
+}
+
+
+def _tpu_export(fn, *args):
+    from jax import export
+
+    return export.export(jax.jit(fn), platforms=("tpu",))(*args)
+
+
+def _offchip_lowering_support():
+    """(capable, reason): probe with a trivial copy kernel so an
+    environment that cannot lower TPU Pallas at all (old jax, missing
+    Mosaic bits) SKIPS, while a whitening-kernel regression FAILS."""
+    try:
+        from jax import export  # noqa: F401
+        from jax.experimental import pallas as pl
+    except ImportError as e:
+        return False, f"missing API: {e}"
+
+    def copy_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+
+    def trivial(x):
+        return pl.pallas_call(
+            copy_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=False,
+        )(x)
+
+    try:
+        _tpu_export(trivial, jax.ShapeDtypeStruct((8, 128), jnp.float32))
+    except Exception as e:  # pragma: no cover - env-dependent
+        return False, f"{type(e).__name__}: {e}"
+    return True, ""
+
+
+@pytest.mark.parametrize("site", sorted(_SITES))
+def test_kernels_lower_for_tpu_offchip(site):
+    capable, why = _offchip_lowering_support()
+    if not capable:
+        pytest.skip(f"this jax cannot lower TPU Pallas off-chip: {why}")
+    from dwt_tpu.ops.pallas_whitening import _apply_call
+
+    rows, c = _SITES[site]
+    g = 4
+    exp = _tpu_export(
+        lambda x: _moments_call(x, c // g, g, interpret=False),
+        jax.ShapeDtypeStruct((rows, c), jnp.float32),
+    )
+    assert "tpu_custom_call" in exp.mlir_module()  # Mosaic, not interpret
+    # Apply pass in bf16 — the MXU path the flagship config runs.
+    exp = _tpu_export(
+        lambda x, m, w: _apply_call(x, m, w, interpret=False),
+        jax.ShapeDtypeStruct((rows, c), jnp.bfloat16),
+        jax.ShapeDtypeStruct((c,), jnp.float32),
+        jax.ShapeDtypeStruct((c // g, g, g), jnp.float32),
+    )
+    assert "tpu_custom_call" in exp.mlir_module()

@@ -1,0 +1,122 @@
+"""Tier-5 verdict-path CI dry-run (VERDICT.md next-round #6).
+
+Drives ``dwt-officehome-sweep --expect_table`` END-TO-END — argument
+parsing, per-pair dispatch, results JSON, ``sweep_verdicts``, the verdict
+table printing, and the exit-code contract — with the per-pair training
+stubbed to canned accuracies, so the whole tier-5 decision path runs in
+milliseconds without a dataset or a model.
+"""
+
+import json
+
+import pytest
+
+from dwt_tpu.cli import officehome as _oh
+from dwt_tpu.cli import officehome_sweep as sweep
+
+
+@pytest.fixture
+def stub_runs(monkeypatch):
+    """Replace per-pair training with canned accuracies keyed by the
+    metrics filename tag the sweep assigns each pair."""
+    calls = []
+
+    def install(accuracies):
+        def fake_run(args):
+            # The sweep mutates args per pair; the jsonl tag carries the
+            # pair identity on the --synthetic path (no dataset paths).
+            tag = args.metrics_jsonl or f"pair{len(calls)}"
+            calls.append(tag)
+            for key, acc in accuracies.items():
+                if key in tag:
+                    return acc
+            raise AssertionError(f"unexpected pair invocation: {tag}")
+
+        monkeypatch.setattr(_oh, "run_from_args", fake_run)
+        return calls
+
+    return install
+
+
+def _base_argv(tmp_path, results):
+    return [
+        "--synthetic",
+        "--pairs", "Art:Clipart,Clipart:Art",
+        "--metrics_jsonl", str(tmp_path / "m.jsonl"),
+        "--results_json", str(results),
+    ]
+
+
+def test_sweep_verdict_all_ok_and_results_json(
+    tmp_path, stub_runs, capsys
+):
+    table = tmp_path / "expect.json"
+    # One checked pair (within ±0.3 of the canned 50.1), one null (the
+    # paper value not yet transcribed -> counted as skipped, not failed).
+    table.write_text(
+        json.dumps({"Art->Clipart": 50.0, "Clipart->Art": None})
+    )
+    stub_runs({"Art2Clipart": 50.1, "Clipart2Art": 47.7})
+    results = tmp_path / "sweep.json"
+
+    mean = sweep.main(
+        _base_argv(tmp_path, results) + ["--expect_table", str(table)]
+    )
+    assert mean == pytest.approx((50.1 + 47.7) / 2)
+
+    out = capsys.readouterr().out
+    assert "[verdict] Art->Clipart:" in out and "OK" in out
+    assert "no expectation" in out  # the null entry's skip line
+    assert "checked=1 skipped=1 all_ok=True" in out
+
+    payload = json.loads(results.read_text())
+    assert payload["pairs"]["Art->Clipart"] == pytest.approx(50.1)
+    assert payload["verdicts"]["all_ok"] is True
+    assert payload["verdicts"]["pairs"]["Art->Clipart"]["ok"] is True
+
+
+def test_sweep_verdict_failure_exits_nonzero(tmp_path, stub_runs, capsys):
+    table = tmp_path / "expect.json"
+    table.write_text(
+        json.dumps({"Art->Clipart": 60.0, "Clipart->Art": 47.5})
+    )
+    stub_runs({"Art2Clipart": 50.1, "Clipart2Art": 47.7})
+    results = tmp_path / "sweep.json"
+
+    with pytest.raises(SystemExit) as e:
+        sweep.main(
+            _base_argv(tmp_path, results) + ["--expect_table", str(table)]
+        )
+    assert e.value.code == 1
+
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "all_ok=False" in out
+    # The results JSON still records the verdicts of the failed sweep —
+    # the artifact a CI job attaches.
+    payload = json.loads(results.read_text())
+    assert payload["verdicts"]["all_ok"] is False
+    assert payload["verdicts"]["pairs"]["Art->Clipart"]["ok"] is False
+    assert payload["verdicts"]["pairs"]["Clipart->Art"]["ok"] is True
+
+
+def test_sweep_rejects_unknown_expectation_keys(tmp_path, stub_runs):
+    """A typo'd table key must fail fast BEFORE any pair trains."""
+    table = tmp_path / "expect.json"
+    table.write_text(json.dumps({"Art->Porduct": 50.0}))
+    calls = stub_runs({})
+    with pytest.raises(SystemExit, match="match no planned pair"):
+        sweep.main(
+            _base_argv(tmp_path, tmp_path / "r.json")
+            + ["--expect_table", str(table)]
+        )
+    assert calls == []  # nothing trained
+
+
+def test_sweep_rejects_single_run_expect_accuracy(tmp_path, stub_runs):
+    calls = stub_runs({})
+    with pytest.raises(SystemExit, match="expect_table"):
+        sweep.main(
+            _base_argv(tmp_path, tmp_path / "r.json")
+            + ["--expect_accuracy", "50.0"]
+        )
+    assert calls == []

@@ -1,0 +1,185 @@
+"""Eval-path microbench: per-batch host syncs vs the device-resident
+pipeline (ISSUE-4 evidence).
+
+The pre-ISSUE-4 eval loop paid one dispatch AND one blocking ``float()``
+host sync per batch — through the axon relay each sync is a full
+round-trip (PERF.md: ~60-70 ms), so a B-batch eval paid B round-trips of
+pure stall.  The pipeline (``dwt_tpu.train.evalpipe``) keeps the three
+counters device-resident, scans k batches per dispatch
+(``--eval_steps_per_dispatch``), and fetches ONCE per pass: B-batch eval
+→ ``ceil(B/k)`` dispatches + 1 fetch.
+
+This bench measures both shapes on the same model/data and reports:
+
+* ``host_syncs``: device→host rendezvous per eval pass (the relay-cost
+  proxy; the CPU numbers under-state the win by the full round-trip
+  latency the relay adds per sync),
+* ``stall_ms_per_batch``: time spent blocked in those syncs, per batch,
+* ``imgs_per_s``: end-to-end pass throughput.
+
+Prints one JSON line.  Run with ``JAX_PLATFORMS=cpu python
+tools/eval_bench.py``; PERF.md "Eval path" records the numbers.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(model_name: str):
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.nn import LeNetDWT, ResNetDWT
+    from dwt_tpu.train import adam_l2, create_train_state
+
+    if model_name == "lenet":
+        factory = lambda axis_name=None: LeNetDWT(
+            group_size=4, axis_name=axis_name
+        )
+        shape, domains = (28, 28, 1), 2
+    elif model_name == "tiny-resnet":
+        factory = lambda axis_name=None: ResNetDWT(
+            stage_sizes=(1, 1, 1, 1), num_classes=10, group_size=4,
+            axis_name=axis_name,
+        )
+        shape, domains = (32, 32, 3), 3
+    else:
+        raise SystemExit(f"unknown --model {model_name!r}")
+    sample = jnp.zeros((domains, 4) + shape, jnp.float32)
+    state = create_train_state(
+        factory(), jax.random.key(0), sample, adam_l2(1e-3)
+    )
+    return factory, state, shape
+
+
+def make_dataset(n: int, shape):
+    import numpy as np
+
+    from dwt_tpu.data import ArrayDataset
+
+    rng = np.random.default_rng(0)
+    return ArrayDataset(
+        rng.normal(size=(n,) + shape).astype(np.float32),
+        rng.integers(0, 10, size=(n,)).astype(np.int64),
+    )
+
+
+def bench_legacy(eval_step, state, dataset, batch_size: int):
+    """The pre-ISSUE-4 loop: dispatch + 3 blocking scalar fetches per
+    batch.  ``eval_step`` is built ONCE by the caller and warmed before
+    the timed pass — constructing it here would hand the timed pass a
+    fresh jit wrapper whose retrace/compile books as phantom legacy
+    slowness.  Returns (seconds, sync_seconds, host_syncs, counters)."""
+    from dwt_tpu.data import batch_iterator
+
+    loss_sum, correct, count, syncs, sync_s = 0.0, 0, 0, 0, 0.0
+    t0 = time.perf_counter()
+    for x, y in batch_iterator(
+        dataset, batch_size, shuffle=False, drop_last=False
+    ):
+        out = eval_step(state.params, state.batch_stats, x, y)
+        s0 = time.perf_counter()
+        loss_sum += float(out["loss_sum"])
+        correct += int(out["correct"])
+        count += int(out["count"])
+        sync_s += time.perf_counter() - s0
+        syncs += 3
+    return time.perf_counter() - t0, sync_s, syncs, (loss_sum, correct, count)
+
+
+def bench_pipeline(factory, state, dataset, batch_size: int, k: int):
+    """The ISSUE-4 pipeline; counts fetches through the module seam."""
+    from dwt_tpu.train import EvalPipeline
+    from dwt_tpu.train import evalpipe
+
+    fetches, fetch_s = [], [0.0]
+    real_fetch = evalpipe._fetch
+
+    def counting_fetch(tree):
+        s0 = time.perf_counter()
+        out = real_fetch(tree)
+        fetch_s[0] += time.perf_counter() - s0
+        fetches.append(1)
+        return out
+
+    evalpipe._fetch = counting_fetch
+    try:
+        pipe = EvalPipeline(factory, batch_size, eval_k=k)
+        pipe.evaluate(state, dataset)  # warmup: compiles outside timing
+        fetches.clear()
+        fetch_s[0] = 0.0
+        t0 = time.perf_counter()
+        result = pipe.evaluate(state, dataset)
+        seconds = time.perf_counter() - t0
+    finally:
+        evalpipe._fetch = real_fetch
+    return seconds, fetch_s[0], len(fetches), result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="eval-path stall/throughput bench")
+    p.add_argument("--model", choices=["lenet", "tiny-resnet"],
+                   default="lenet")
+    p.add_argument("--items", type=int, default=512)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--k", type=int, default=8,
+                   help="eval_steps_per_dispatch for the pipelined mode")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from dwt_tpu.train import make_eval_step
+
+    factory, state, shape = build(args.model)
+    dataset = make_dataset(args.items, shape)
+    batches = -(-args.items // args.batch)
+
+    # ONE jitted legacy step, warmed with a full pass so the timed pass
+    # measures steady-state eval, not trace+compile (the pipeline arm is
+    # warmed the same way — symmetric timing).
+    eval_step = jax.jit(make_eval_step(factory()))
+    bench_legacy(eval_step, state, dataset, args.batch)
+    leg_s, leg_sync_s, leg_syncs, leg_counters = bench_legacy(
+        eval_step, state, dataset, args.batch
+    )
+    k1_s, k1_fetch_s, k1_fetches, k1_result = bench_pipeline(
+        factory, state, dataset, args.batch, k=1
+    )
+    kn_s, kn_fetch_s, kn_fetches, kn_result = bench_pipeline(
+        factory, state, dataset, args.batch, k=args.k
+    )
+    assert kn_result["count"] == leg_counters[2], "parity violation"
+
+    record = {
+        "model": args.model,
+        "items": args.items,
+        "batch": args.batch,
+        "batches": batches,
+        "legacy": {
+            "imgs_per_s": round(args.items / leg_s, 1),
+            "host_syncs": leg_syncs,
+            "stall_ms_per_batch": round(leg_sync_s / batches * 1e3, 3),
+        },
+        "pipeline_k1": {
+            "imgs_per_s": round(args.items / k1_s, 1),
+            "host_fetches": k1_fetches,
+            "stall_ms_per_batch": round(k1_fetch_s / batches * 1e3, 3),
+        },
+        f"pipeline_k{args.k}": {
+            "imgs_per_s": round(args.items / kn_s, 1),
+            "host_fetches": kn_fetches,
+            "stall_ms_per_batch": round(kn_fetch_s / batches * 1e3, 3),
+        },
+        "host_sync_reduction_x": round(leg_syncs / max(kn_fetches, 1), 1),
+    }
+    print(json.dumps(record))
+    return record
+
+
+if __name__ == "__main__":
+    main()
