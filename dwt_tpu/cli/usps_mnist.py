@@ -97,6 +97,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "under ckpt_dir/watchdog/ and exit 113 so the "
                         "scheduler relaunches into resume; budget for the "
                         "first step's compile and boundary evals.  0 = off")
+    p.add_argument("--watchdog_keep", type=int, default=d.watchdog_keep,
+                   help="cap on retained watchdog stack dumps under "
+                        "ckpt_dir/watchdog/ (oldest pruned first); a "
+                        "relaunch loop must not fill the disk")
+    p.add_argument("--preempt_notice_file", type=str,
+                   default=d.preempt_notice_file,
+                   help="preemption notice file: when this path comes "
+                        "into existence (scheduler prolog/preStop hook), "
+                        "every host takes a proactive save at the next "
+                        "step boundary while training continues — the "
+                        "later SIGTERM exits fast")
+    p.add_argument("--preempt_notice_metadata",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.preempt_notice_metadata,
+                   help="poll the GCE instance/preempted metadata key "
+                        "(~30 s advance warning on spot/preemptible VMs) "
+                        "as a preemption notice source; URL overridable "
+                        "via DWT_PREEMPT_METADATA_URL for tests")
     p.add_argument("--keep_ckpts", type=int, default=d.keep_ckpts,
                    help=">0: prune the main --ckpt_dir to the newest N "
                         "steps after each periodic/final save; anchors "

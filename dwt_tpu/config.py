@@ -86,6 +86,15 @@ class DigitsConfig:
     # WATCHDOG_EXIT_CODE (113) so schedulers relaunch into resume.
     # Budget for the first step's jit compile and boundary evals.  0 = off.
     watchdog_timeout: float = 0.0
+    # Cap on retained ckpt_dir/watchdog/stacks-*.txt dumps (oldest pruned
+    # first): a relaunch loop must not fill the disk with its own
+    # evidence.
+    watchdog_keep: int = 5
+    # Preemption notice (resilience/notice.py): a notice on ANY host
+    # triggers an all-host proactive save at the next step boundary while
+    # training continues, so the later SIGTERM exits fast.
+    preempt_notice_file: Optional[str] = None  # notice = this file exists
+    preempt_notice_metadata: bool = False  # poll the GCE preempted key
 
 
 @dataclasses.dataclass
@@ -153,5 +162,9 @@ class OfficeHomeConfig:
     # Guard lr-backoff rung — see DigitsConfig.guard_lr_backoff.
     guard_lr_backoff: float = 0.0
     guard_backoff_recovery: int = 3
-    # Hang watchdog — see DigitsConfig.watchdog_timeout.
+    # Hang watchdog — see DigitsConfig.watchdog_timeout / watchdog_keep.
     watchdog_timeout: float = 0.0
+    watchdog_keep: int = 5
+    # Preemption notice — see DigitsConfig.preempt_notice_*.
+    preempt_notice_file: Optional[str] = None
+    preempt_notice_metadata: bool = False

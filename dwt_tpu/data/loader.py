@@ -66,18 +66,38 @@ class QuarantineRegistry:
         self._merge_from_disk()
 
     def _merge_from_disk(self) -> None:
+        """Fail-soft merge: a truncated, garbage, or wrong-shaped registry
+        file must never crash a run at startup — the worst it can cost is
+        re-quarantining known-bad items as they fail again.  Every
+        structural surprise (non-object JSON, non-list values, non-int
+        ids) degrades to a warning + whatever subset parsed cleanly."""
         try:
             with open(self.path) as f:
                 raw = json.load(f)
-            for k, v in raw.items():
-                self._known.setdefault(str(k), set()).update(int(i) for i in v)
         except FileNotFoundError:
-            pass
-        except (OSError, ValueError, TypeError) as e:
-            # A torn/corrupt registry must not kill a resume; items will
-            # simply re-quarantine (and rewrite the file) as they fail.
-            log.warning("quarantine registry %s unreadable (%s); ignoring "
-                        "its contents", self.path, e)
+            return
+        except (OSError, ValueError) as e:
+            # Torn bytes or invalid JSON (a crash mid-write, a dead mount).
+            log.warning("quarantine registry %s unreadable (%s); starting "
+                        "from an empty registry", self.path, e)
+            return
+        if not isinstance(raw, dict):
+            log.warning(
+                "quarantine registry %s is not a JSON object (got %s); "
+                "starting from an empty registry",
+                self.path, type(raw).__name__,
+            )
+            return
+        for k, v in raw.items():
+            try:
+                ids = {int(i) for i in v}
+            except (ValueError, TypeError) as e:
+                log.warning(
+                    "quarantine registry %s: ignoring malformed entry "
+                    "%r (%s)", self.path, k, e,
+                )
+                continue
+            self._known.setdefault(str(k), set()).update(ids)
 
     @classmethod
     def for_ckpt_dir(cls, ckpt_dir: str) -> "QuarantineRegistry":

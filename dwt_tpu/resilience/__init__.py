@@ -26,14 +26,26 @@ in CI on CPU:
 * :class:`AsyncCheckpointer` — single-in-flight background checkpoint
   pipeline (snapshot → digest → write off the hot path; rendezvous via
   ``flush()`` at preemption/final/rollback/best-record points).
+  :class:`MultiHostAsyncCheckpointer` is its collective-free multi-host
+  form: host-side snapshot on the main thread, pure-I/O per-process
+  shard writes, and process-0 promotion driven by save-done bits on the
+  consensus vector.
+* :class:`NoticeWatcher` — scheduler preemption-notice polling (GCE
+  metadata / notice file); any-host notice → all-host proactive save at
+  the next boundary, so the later SIGTERM exits fast.
 * atomic validated checkpoints live in :mod:`dwt_tpu.utils.checkpoint`
   (write-to-tmp + rename, per-step manifest, newest-valid fallback);
   retry/quarantine item loading lives in :mod:`dwt_tpu.data.loader`.
 """
 
 from dwt_tpu.resilience import inject
-from dwt_tpu.resilience.async_ckpt import AsyncCheckpointer, snapshot_state
+from dwt_tpu.resilience.async_ckpt import (
+    AsyncCheckpointer,
+    MultiHostAsyncCheckpointer,
+    snapshot_state,
+)
 from dwt_tpu.resilience.coord import Coordinator, Decision
+from dwt_tpu.resilience.notice import NoticeWatcher
 from dwt_tpu.resilience.guard import (
     POLICIES,
     DivergenceError,
@@ -45,6 +57,8 @@ from dwt_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE, HangWatchdog
 
 __all__ = [
     "AsyncCheckpointer",
+    "MultiHostAsyncCheckpointer",
+    "NoticeWatcher",
     "snapshot_state",
     "Coordinator",
     "Decision",

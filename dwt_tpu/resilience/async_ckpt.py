@@ -37,14 +37,25 @@ Correctness rules the train loops must follow (and do — ``train/loop.py``):
   save is *not* silently dropped — the caller sees the failure exactly
   like a synchronous save raising).
 
-Multi-host: NOT supported — the writer thread dispatches device work
-(the finite-gate jit, ``save_state``'s cross-process barrier) whose
-launch order relative to the main thread's train-step collectives is
-thread-scheduling dependent, and multi-host JAX requires an identical
-collective launch order on every process (mismatch = runtime deadlock).
-The train loops therefore downgrade ``--async_ckpt`` to the synchronous
-save path when ``jax.process_count() > 1``; a collective-free writer
-(host-side snapshot, pure-I/O task) is the future lift for multi-host.
+Multi-host (ISSUE-5): the Orbax-based writer above cannot run there —
+it dispatches device work (the finite-gate jit, ``save_state``'s
+cross-process barrier) whose launch order relative to the main thread's
+train-step collectives is thread-scheduling dependent, and multi-host
+JAX requires an identical collective launch order on every process
+(mismatch = runtime deadlock).  :class:`MultiHostAsyncCheckpointer` is
+the collective-free variant: the MAIN thread takes the jitted snapshot
+and fetches it host-side (``checkpoint.host_fetch`` — the whole hot-path
+cost); the writer thread is then **pure I/O**, writing only this
+process's replica under ``<step tmp>/shard_<proc>/`` (host-shard format,
+``utils/checkpoint.py``).  Global finalization is a filesystem
+rendezvous driven from step boundaries: each host piggybacks its
+"my writer completed save #k" sequence number on the Coordinator's
+consensus vector (a sequence, not a step — the same step can be saved
+twice), and process 0 promotes a save (validate shards → top-level
+manifest → atomic rename) once the agreed min reaches it.  No
+collective, no barrier, nothing device-touching ever runs off the main
+thread — enforced by ``coord.assert_not_writer_thread`` on every
+collective call site.
 """
 
 from __future__ import annotations
@@ -188,3 +199,146 @@ class AsyncCheckpointer:
             return
         self._join()
         self._error = self._error_step = None
+
+
+class MultiHostAsyncCheckpointer(AsyncCheckpointer):
+    """Collective-free async writer for multi-host runs (module doc).
+
+    Same single-in-flight/backpressure/error contract as the base class;
+    what changes is the split of work:
+
+    * :meth:`save_multi` (main thread) — jitted snapshot, host-side fetch
+      (``host_fetch`` blocks on the state's producing computation; that
+      fetch IS the hot-path cost), enqueue.
+    * writer thread — ``save_host_shard`` per target: raw leaf bytes +
+      shard manifest under the step's tmp dir.  Pure I/O; the finite
+      gate runs on host numpy.  On success the step is recorded as this
+      host's ``done_step`` (read by the loops' boundary consensus) and
+      its targets queue for promotion.
+    * :meth:`promote_up_to` (main thread) — once the consensus says every
+      host's shard of step N is durable, process 0 validates + finalizes
+      (``promote_host_shards``); other processes just drop the pending
+      entry.  Promotion failures surface exactly like writer errors: on
+      the next save/flush.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        # Saves are numbered by a per-host sequence counter (identical
+        # across hosts: saves come from lockstep control flow).  The
+        # done bit gathered by the consensus is a SEQUENCE, not a step:
+        # the same step can be saved twice (notice save + cadence save),
+        # and a stale same-step done bit must not green-light promotion
+        # while a slower host's writer is still rewriting its shard.
+        self._seq = 0
+        self._done_seq = -1
+        # [(seq, step, ckpt_dir, save_state-style kwargs)] completed
+        # shard writes awaiting global promotion, oldest first.  Appended
+        # by the writer thread, consumed on the main thread — guarded by
+        # the single-in-flight join (the writer is dead or quiescent
+        # whenever the main thread reads it at a boundary... except
+        # between boundaries, so a lock keeps the append/drain race
+        # benign).
+        self._pending = []
+        self._pending_lock = threading.Lock()
+
+    # ------------------------------------------------------------- internals
+
+    def _run(self, targets, seq: int, step: int, host_tree) -> None:
+        from dwt_tpu.utils.checkpoint import save_host_shard
+
+        try:
+            for ckpt_dir, kwargs in targets:
+                wrote = save_host_shard(
+                    ckpt_dir, step, host_tree, self.process_index,
+                    require_finite=kwargs.get("require_finite", True),
+                )
+                if wrote:
+                    with self._pending_lock:
+                        self._pending.append(
+                            (int(seq), int(step), ckpt_dir, dict(kwargs))
+                        )
+            # Done-bit ordering: the save counts as "done" only after
+            # EVERY target's shard is durably written (a promotion
+            # triggered between two targets would finalize the first
+            # while the second is mid-write).
+            self._done_seq = int(seq)
+        except BaseException as e:  # surfaced on the next enqueue/flush
+            self._error = e
+            self._error_step = step
+            log.warning("async shard save @%d failed: %s", step, e)
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def done_seq(self) -> int:
+        """Sequence number of the newest save THIS host's writer has
+        fully completed (-1: none yet).  Fed into the boundary consensus
+        vector; the agreed min across hosts is the promotion frontier."""
+        return self._done_seq
+
+    def join(self) -> None:
+        """Join the in-flight writer WITHOUT raising its error — for
+        rendezvous sequencing where collectives must still be issued in
+        lockstep before a host-local failure may surface."""
+        self._join()
+
+    def save_multi(self, targets, step: int, state) -> None:
+        self._join()
+        self._raise_pending()
+        from dwt_tpu.utils.checkpoint import host_fetch
+
+        # Snapshot + host fetch on the MAIN thread: the fetch blocks on
+        # the state's producing computation (the hot-path cost of a
+        # multi-host save); an exception here enqueues nothing.
+        host_tree = host_fetch(snapshot_state(state))
+        self._seq += 1
+        self._pending_step = int(step)
+        self._thread = threading.Thread(
+            target=self._run,
+            args=(list(targets), self._seq, int(step), host_tree),
+            name=f"dwt-ckpt-writer-{int(step)}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def promote_up_to(self, agreed_seq: int) -> None:
+        """Finalize every pending save with sequence <= ``agreed_seq``.
+
+        Main thread only.  ``agreed_seq`` is the consensus min of all
+        hosts' ``done_seq`` — by construction every host's writer has
+        fully completed those saves, so a failed validation here is a
+        real fault (torn shard, dead filesystem) and is queued to
+        surface on the next save/flush, after which restore falls back
+        past the unpromoted tmp dir.
+        """
+        if agreed_seq < 0:
+            return
+        with self._pending_lock:
+            due = [p for p in self._pending if p[0] <= agreed_seq]
+            self._pending = [p for p in self._pending if p[0] > agreed_seq]
+        for _seq, step, ckpt_dir, kwargs in due:
+            if self.process_index != 0:
+                continue
+            from dwt_tpu.utils.checkpoint import promote_host_shards
+
+            try:
+                self._last_path = promote_host_shards(
+                    ckpt_dir, step, self.process_count,
+                    keep=kwargs.get("keep"),
+                )
+            except OSError as e:
+                if self._error is None:
+                    self._error = e
+                    self._error_step = step
+                log.warning("checkpoint promotion @%d failed: %s", step, e)
+
+    def flush(self):
+        """Join the in-flight shard write; raise any writer/promotion
+        error.  NOTE: after a multi-host flush the caller still owes the
+        finalization rendezvous (gather done-bits → promote → barrier) —
+        the loops' ``_CkptPipeline.flush`` owns that sequencing, since
+        only the main loop may issue the collectives it needs."""
+        return super().flush()

@@ -26,6 +26,13 @@ every host can actually restore, guarding against rename-visibility skew
 on shared filesystems (one host's directory listing trailing another's
 finalize by a beat).
 
+The same flag vector carries two further bits (ISSUE-5): each host's
+newest durably-written async-save shard step (min over hosts = the step
+process 0 may promote to a finalized checkpoint — the collective-free
+multi-host async writer's filesystem rendezvous) and the any-host
+preemption *notice* flag (scheduler warning before SIGTERM → all-host
+proactive save at the same boundary).
+
 Single-process runs short-circuit: :meth:`decide` returns the local
 flags without touching any collective or device API — the PR-1 behavior
 at zero overhead.  ``enabled=True`` forces the allgather path even at
@@ -36,6 +43,7 @@ CI exercises the consensus code on a single host.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Optional
 
@@ -50,6 +58,28 @@ EVENT_ROLLBACK = 2  # rollback requested (rollback_step carries the step)
 EVENT_HALT = 3  # guard says stop the run
 
 
+def assert_not_writer_thread(what: str) -> None:
+    """Refuse a collective (or collective-bearing call) on a checkpoint
+    writer thread.
+
+    Multi-host JAX requires an identical collective launch order on every
+    process; a collective issued from the async checkpoint writer would be
+    ordered against the main thread's train-step collectives by thread
+    scheduling — a nondeterministic order, i.e. an eventual deadlock.
+    The multi-host async writer is pure I/O by construction (ISSUE-5); this
+    check is the always-on shim that keeps it that way: the writer threads
+    carry a recognizable name, so the check is one string comparison and
+    cannot false-positive on loops legitimately driven off-main.
+    """
+    name = threading.current_thread().name
+    if name.startswith("dwt-ckpt-writer"):
+        raise RuntimeError(
+            f"{what} called from checkpoint writer thread {name!r} — the "
+            "async writer must stay pure I/O (collectives launched off the "
+            "main thread deadlock multi-host runs)"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class Decision:
     """The agreed all-host verdict for one step boundary."""
@@ -57,6 +87,20 @@ class Decision:
     stop: bool  # some host was preempted: save and exit 0, together
     event: int  # max EVENT_* code across hosts: the rung everyone takes
     rollback_step: int  # failed step of a rollback proposal; -1 = none
+    # Newest async-save SEQUENCE NUMBER every host's writer has durably
+    # completed (min over hosts; -1 = none): process 0 may promote the
+    # checkpoints of saves up to this sequence.  A sequence — not the
+    # step — because the same step can legitimately be saved twice (a
+    # notice-driven proactive save coinciding with the cadence save),
+    # and a stale same-step done bit must not green-light promotion
+    # while a slower host's writer is still rewriting its shard.  Saves
+    # are issued by lockstep control flow, so sequence numbers agree
+    # across hosts.  Hosts without a multi-host async writer report -1.
+    save_done_seq: int = -1
+    # Some host observed a preemption NOTICE (scheduler metadata warning /
+    # notice file): every host takes a proactive save at this boundary
+    # while training continues.
+    notice: bool = False
 
     @property
     def diverged(self) -> bool:
@@ -102,6 +146,7 @@ class Coordinator:
         """
         from jax.experimental import multihost_utils
 
+        assert_not_writer_thread("consensus allgather")
         flags = np.asarray(list(values), np.int32)
         return np.asarray(
             multihost_utils.process_allgather(flags)
@@ -112,19 +157,36 @@ class Coordinator:
         stop: bool = False,
         event: int = EVENT_NONE,
         rollback_step: int = -1,
+        save_done_seq: int = -1,
+        notice: bool = False,
     ) -> Decision:
         """Combine each host's local flags into one global decision.
 
         Must be called at the SAME boundary on every host (the loops call
         it once per step/chunk) — it is a collective when enabled, and a
         plain passthrough (no device work at all) otherwise.
+
+        ``save_done_seq`` piggybacks the multi-host async checkpoint
+        writer's "my writer completed save #k" bit on the existing
+        vector: the agreed value is the MIN over hosts — the newest save
+        every host has durably written — which is exactly the promotion
+        frontier for process 0's filesystem rendezvous (no extra
+        collective, no barrier on the writer; see the field doc on
+        :class:`Decision` for why a sequence, not a step).  ``notice``
+        is the any-host preemption warning (scheduler metadata / notice
+        file): OR-combined, so one host's notice triggers everyone's
+        proactive save at the same boundary.
         """
         if not self.enabled:
-            return Decision(bool(stop), int(event), int(rollback_step))
+            return Decision(
+                bool(stop), int(event), int(rollback_step),
+                int(save_done_seq), bool(notice),
+            )
         t0 = time.perf_counter()
-        gathered = self._allgather(
-            [int(bool(stop)), int(event), int(rollback_step)]
-        )
+        gathered = self._allgather([
+            int(bool(stop)), int(event), int(rollback_step),
+            int(save_done_seq), int(bool(notice)),
+        ])
         dt = time.perf_counter() - t0
         self.decides += 1
         self.last_decide_s = dt
@@ -134,6 +196,8 @@ class Coordinator:
             stop=bool(gathered[:, 0].any()),
             event=int(gathered[:, 1].max()),
             rollback_step=int(gathered[:, 2].max()),
+            save_done_seq=int(gathered[:, 3].min()),
+            notice=bool(gathered[:, 4].any()),
         )
 
     def agree_step(self, step: int) -> int:

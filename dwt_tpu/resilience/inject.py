@@ -25,10 +25,17 @@ production code at exactly the points the real fault would strike:
   backoff; a larger one surfaces as a diagnosed save failure.
 * ``at_step(lo, hi)`` — the step-boundary control faults, called by the
   loops once per step/chunk: ``slow_step`` (sleep once — a transient
-  stall a sane watchdog timeout must tolerate), ``sigterm_at_step``
-  (self-delivered SIGTERM — deterministic preemption, including
-  one-host-of-many for the consensus tests), and ``hang`` (never return —
-  a wedged collective; only the hang watchdog gets the process out).
+  stall a sane watchdog timeout must tolerate), ``notice_at_step``
+  (preemption NOTICE — the scheduler's advance warning becomes visible
+  on this host; drives the all-host proactive-save consensus),
+  ``sigterm_at_step`` (self-delivered SIGTERM — deterministic
+  preemption, including one-host-of-many for the consensus tests), and
+  ``hang`` (never return — a wedged collective; only the hang watchdog
+  gets the process out).
+* ``maybe_kill_writer_mid_shard(step)`` — called by ``save_host_shard``
+  between the leaf bytes and the shard manifest; SIGKILLs the process,
+  i.e. a host dying mid-shard-write (promotion must refuse the torn
+  shard; the previous finalized step stays authoritative).
 * ``wrap_dataset(ds, role)`` — wraps a train dataset in
   :class:`FlakyDataset` when the plan condemns items for that role,
   driving the loader's retry/quarantine path from a subprocess.
@@ -112,10 +119,21 @@ class FaultPlan:
     # {"source": [idx, ...], "target": [...]} — items the loops' datasets
     # report as corrupt (the loader quarantines them).
     corrupt_items: Optional[Dict[str, List[int]]] = None
+    # Step boundary at which a preemption NOTICE becomes visible on this
+    # host (stands in for the GCE metadata warning / a scheduler notice
+    # file): the loops take an all-host proactive save and keep training.
+    notice_at_step: Optional[int] = None
+    # SIGKILL this process from inside the host-shard writer, after the
+    # leaf bytes are written but before the shard manifest — a host dying
+    # mid-shard-write.  True = next shard write; int = the save at that
+    # step.  Promotion must refuse the torn shard and the previous
+    # finalized step stays authoritative.
+    kill_writer_mid_shard: Any = None
 
     _FIELDS = (
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
         "slow_step_s", "sigterm_at_step", "io_error_saves", "corrupt_items",
+        "notice_at_step", "kill_writer_mid_shard",
     )
 
     @classmethod
@@ -155,6 +173,14 @@ class FaultPlan:
         hang = _opt_int("hang_at_step")
         slow = _opt_int("slow_step_at")
         sigterm = _opt_int("sigterm_at_step")
+        notice = _opt_int("notice_at_step")
+        if notice is not None and sigterm is not None and notice >= sigterm:
+            raise ValueError(
+                f"{ENV_VAR}: notice_at_step ({notice}) must precede "
+                f"sigterm_at_step ({sigterm}) — a notice is the scheduler's "
+                "advance warning, and a plan where it cannot fire before "
+                "the SIGTERM proves nothing about the proactive save"
+            )
         if hang is not None and sigterm is not None:
             raise ValueError(
                 f"{ENV_VAR}: hang_at_step and sigterm_at_step cannot "
@@ -190,6 +216,14 @@ class FaultPlan:
                 f"{ENV_VAR}: crash_in_save must be true (next save) or an "
                 f"int step >= 1; got {crash!r}"
             )
+        kill_writer = spec.get("kill_writer_mid_shard")
+        if kill_writer is not None and kill_writer is not True and (
+                isinstance(kill_writer, bool)
+                or not isinstance(kill_writer, int) or kill_writer < 1):
+            raise ValueError(
+                f"{ENV_VAR}: kill_writer_mid_shard must be true (next "
+                f"shard write) or an int step >= 1; got {kill_writer!r}"
+            )
         corrupt = spec.get("corrupt_items")
         if corrupt is not None:
             if not isinstance(corrupt, dict):
@@ -220,6 +254,8 @@ class FaultPlan:
             sigterm_at_step=sigterm,
             io_error_saves=io_saves,
             corrupt_items=corrupt,
+            notice_at_step=notice,
+            kill_writer_mid_shard=kill_writer,
         )
 
     @classmethod
@@ -266,6 +302,11 @@ def disarm() -> None:
     # Re-reading the env on the next current() would re-arm a consumed
     # subprocess plan — mark it checked so disarm is final in-process.
     _env_checked = True
+    # A fired notice_at_step latched the notice module's injected flag;
+    # clear it so in-process tests cannot leak a notice into each other.
+    from dwt_tpu.resilience import notice as _notice
+
+    _notice.reset_injected()
 
 
 def current() -> Optional[FaultPlan]:
@@ -339,15 +380,18 @@ def maybe_io_error(what: str = "save") -> None:
 
 
 def at_step(lo: int, hi: Optional[int] = None) -> None:
-    """Step-boundary control faults: slow, then SIGTERM, then hang.
+    """Step-boundary control faults: slow, then notice, then SIGTERM,
+    then hang.
 
     Ordering matters for composed plans at one boundary: a slow step must
-    finish (the watchdog tolerates it) before the terminal faults.  Hang
-    and SIGTERM never share a plan (``from_spec`` rejects the combination
-    — chunked dispatch could land both on one boundary, where the hang
-    would silently swallow the SIGTERM); the hang never returns — only
-    the watchdog (or the scheduler's SIGKILL) ends the process, exactly
-    like a wedged collective.
+    finish (the watchdog tolerates it) before the terminal faults, and a
+    preemption notice must become visible before the SIGTERM it warns of
+    (``from_spec`` additionally requires the notice STEP to precede the
+    SIGTERM step).  Hang and SIGTERM never share a plan (``from_spec``
+    rejects the combination — chunked dispatch could land both on one
+    boundary, where the hang would silently swallow the SIGTERM); the
+    hang never returns — only the watchdog (or the scheduler's SIGKILL)
+    ends the process, exactly like a wedged collective.
     """
     plan = current()
     if plan is None:
@@ -356,6 +400,11 @@ def at_step(lo: int, hi: Optional[int] = None) -> None:
     if plan.slow_step_at is not None and lo <= plan.slow_step_at <= hi:
         plan.slow_step_at = None  # one-shot
         time.sleep(plan.slow_step_s)
+    if plan.notice_at_step is not None and lo <= plan.notice_at_step <= hi:
+        plan.notice_at_step = None  # one-shot
+        from dwt_tpu.resilience import notice as _notice
+
+        _notice.trigger_injected()
     if plan.sigterm_at_step is not None and lo <= plan.sigterm_at_step <= hi:
         plan.sigterm_at_step = None  # one-shot
         os.kill(os.getpid(), signal.SIGTERM)
@@ -363,6 +412,22 @@ def at_step(lo: int, hi: Optional[int] = None) -> None:
         plan.hang_at_step = None
         while True:  # a wedged collective does not poll flags either
             time.sleep(60.0)
+
+
+def maybe_kill_writer_mid_shard(step: int) -> None:
+    """SIGKILL the process if armed for this shard write.  Called by
+    ``save_host_shard`` after the leaf bytes are durably written but
+    before the shard manifest — a real kill (not an exception the writer
+    thread would catch): the whole point is the HOST dying mid-write,
+    leaving a torn shard that promotion must refuse."""
+    plan = current()
+    if plan is None or plan.kill_writer_mid_shard is None:
+        return
+    if plan.kill_writer_mid_shard is True or (
+        int(plan.kill_writer_mid_shard) == int(step)
+    ):
+        plan.kill_writer_mid_shard = None  # one-shot (if we survive…)
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def wrap_dataset(dataset: Any, role: str) -> Any:

@@ -12,6 +12,15 @@ save a final checkpoint and return normally (exit code 0, so schedulers
 don't mark the job failed).  A second SIGINT restores the previous
 handler and raises ``KeyboardInterrupt``: an operator double Ctrl-C still
 kills a run whose final save hangs.
+
+The SIGTERM is usually *announced*: GCE flips an instance-metadata key
+~30 s earlier, and most schedulers can touch a notice file from a
+prolog/preStop hook.  :class:`~dwt_tpu.resilience.notice.NoticeWatcher`
+watches those sources so the loops save proactively (all hosts, same
+boundary, via the consensus notice bit) while training continues — when
+the SIGTERM then lands here, the stop path finds ``notice_step`` already
+durable and exits without writing a second full checkpoint, spending the
+grace window on nothing but the flush/finalize rendezvous.
 """
 
 from __future__ import annotations

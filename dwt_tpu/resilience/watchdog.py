@@ -9,7 +9,9 @@ the job burns its allocation making zero progress until a human notices.
 :class:`HangWatchdog` is a daemon thread fed by step-boundary heartbeats
 from the training loops.  When no heartbeat arrives for
 ``timeout_s`` seconds it (1) dumps ALL thread stacks to
-``ckpt_dir/watchdog/stacks-<pid>.txt`` (``faulthandler`` — exactly the
+``ckpt_dir/watchdog/stacks-<pid>-<ts>.txt`` — capped at the newest
+``keep`` dumps (``--watchdog_keep``), so a relaunch loop (113 → resume →
+hang again) cannot fill the disk — (``faulthandler`` — exactly the
 evidence a post-mortem needs: *which* collective/syscall every thread is
 blocked in), (2) writes one unbuffered line to stderr naming the dump,
 and (3) hard-exits with :data:`WATCHDOG_EXIT_CODE` — distinct from both
@@ -55,15 +57,23 @@ class HangWatchdog:
     injectable for unit tests (the default really exits the process).
     """
 
+    # Default stack-dump retention: a relaunch loop (exit 113 → scheduler
+    # resume → hang again) writes one dump per attempt, forever — without
+    # a cap it fills the checkpoint mount with the evidence of its own
+    # failure.  The newest few dumps carry all the diagnostic value.
+    DEFAULT_KEEP = 5
+
     def __init__(
         self,
         timeout_s: float,
         ckpt_dir: Optional[str] = None,
         logger=None,
+        keep: int = DEFAULT_KEEP,
         _exit: Callable[[int], None] = os._exit,
     ):
         self.timeout_s = float(timeout_s or 0.0)
         self.enabled = self.timeout_s > 0
+        self.keep = max(int(keep), 1)  # the dump being written always stays
         self._ckpt_dir = ckpt_dir
         self._logger = logger  # unused in the handler (see preemption.py);
         # kept for API symmetry with the other resilience context managers.
@@ -131,13 +141,32 @@ class HangWatchdog:
                 self._fire(stalled)
                 return
 
+    def _prune_dumps(self, d: str, keep: int) -> None:
+        """Cap ``stacks-*.txt`` to the newest ``keep`` (oldest mtime
+        first out) — relaunch loops must not fill the disk with dumps."""
+        try:
+            dumps = [
+                os.path.join(d, name)
+                for name in os.listdir(d)
+                if name.startswith("stacks-") and name.endswith(".txt")
+            ]
+            dumps.sort(key=os.path.getmtime)
+            for stale in dumps[: max(len(dumps) - keep, 0)]:
+                os.unlink(stale)
+        except OSError:
+            pass  # retention is best-effort; never blocks the dump/exit
+
     def _dump_stacks(self, stalled: float) -> Optional[str]:
         if not self._ckpt_dir:
             return None
         try:
             d = os.path.join(self._ckpt_dir, "watchdog")
             os.makedirs(d, exist_ok=True)
-            path = os.path.join(d, f"stacks-{os.getpid()}.txt")
+            # pid+timestamp name: successive relaunches (fresh pids) AND a
+            # recycled pid both get distinct files; retention prunes by
+            # age, keeping room for this dump inside the cap.
+            self._prune_dumps(d, self.keep - 1)
+            path = os.path.join(d, f"stacks-{os.getpid()}-{int(time.time())}.txt")
             with open(path, "w") as f:
                 f.write(
                     f"hang watchdog: pid={os.getpid()} "

@@ -54,6 +54,8 @@ from dwt_tpu.resilience import (
     DivergenceError,
     DivergenceGuard,
     HangWatchdog,
+    MultiHostAsyncCheckpointer,
+    NoticeWatcher,
     PreemptionHandler,
     RollbackRequest,
     inject,
@@ -80,6 +82,7 @@ from dwt_tpu.train.steps import (
 )
 from dwt_tpu.utils import (
     MetricLogger,
+    is_valid_checkpoint,
     restore_state,
     save_state,
     valid_steps,
@@ -386,16 +389,45 @@ class _StepBoundary:
     (``self.stop``): on multi-host it may come from ANOTHER host's
     SIGTERM, so the loops consult it — not ``preempt.should_stop`` —
     after leaving the step loop.
+
+    ISSUE-5 additions, both riding the same consensus vector at zero
+    extra collectives: the multi-host async save-done bit (agreed min →
+    ``ckpt.promote_up_to`` — process 0's filesystem rendezvous runs right
+    here at the boundary, so a completed save finalizes within one
+    boundary of every shard landing) and the preemption-notice bit (any
+    host's notice → ``on_notice(state)`` fires ONCE on every host at the
+    same boundary: the proactive save that lets the later SIGTERM exit
+    fast; ``notice_step`` records it for the exit path).
     """
 
-    def __init__(self, guard, preempt, coord, watchdog, logger=None):
+    def __init__(self, guard, preempt, coord, watchdog, logger=None,
+                 ckpt=None, notice_watcher=None):
         self.guard = guard
         self.preempt = preempt
         self.coord = coord
         self.watchdog = watchdog
         self.logger = logger
+        self.ckpt = ckpt
+        self.notice_watcher = notice_watcher
+        self.on_notice = None  # loop-installed: state -> saved step or None
+        self.notice_step: Optional[int] = None  # proactive-save step
+        self._notice_handled = False
         self.stop = False
         self._decides_logged = 0
+
+    def _local_notice(self) -> bool:
+        return (
+            self.notice_watcher is not None and self.notice_watcher.noticed
+        )
+
+    def _handle_notice(self, state) -> None:
+        """All-host proactive save, once: the notice is latched, so it
+        keeps riding the vector, but the save must not repeat every
+        boundary."""
+        if self._notice_handled or self.on_notice is None:
+            return
+        self._notice_handled = True
+        self.notice_step = self.on_notice(state)
 
     def _log_consensus(self, gstep: int) -> None:
         """Aggregate consensus-latency record every N decides."""
@@ -443,11 +475,32 @@ class _StepBoundary:
                 rollback_step=(
                     event.step if isinstance(event, RollbackRequest) else -1
                 ),
+                save_done_seq=(
+                    self.ckpt.done_seq() if self.ckpt is not None else -1
+                ),
+                notice=self._local_notice(),
             )
             self._log_consensus(gstep)
             self.stop = self.stop or decision.stop
+            if self.ckpt is not None:
+                # Promotion frontier: every host's writer has completed
+                # the saves up to the agreed min — process 0 finalizes
+                # them now (pure local filesystem; no-op elsewhere).
+                self.ckpt.promote_up_to(decision.save_done_seq)
             if event is not None:
                 raise event  # every host now knows; act on the local event
+            if (
+                decision.notice
+                and not decision.stop
+                and decision.event == EVENT_NONE
+            ):
+                # Proactive save only on an otherwise-clean boundary, and
+                # only off DECISION fields: a guard event anywhere means
+                # the event-raising host skipped this branch, and a save
+                # enqueued on the mirrors alone would leave shard sets
+                # forever incomplete.  The latched notice simply fires at
+                # the next clean boundary instead.
+                self._handle_notice(state)
             if decision.event > code:
                 # A remote guard outranked this host's view (its fault
                 # preceded the collective, e.g. a host-local data NaN, or
@@ -473,6 +526,8 @@ class _StepBoundary:
         if event is not None:
             raise event
         self.stop = self.stop or self.preempt.should_stop
+        if self._local_notice() and not self.stop:
+            self._handle_notice(state)
         return state, self.stop
 
 
@@ -502,23 +557,28 @@ class _CkptPipeline:
     must be durably on disk before proceeding: preemption save-and-exit,
     the final save, guard rollback/restore, and best-record updates.  On
     the sync path it is a no-op (every save already blocked).
+
+    Multi-host async (ISSUE-5): the writer becomes the collective-free
+    :class:`MultiHostAsyncCheckpointer` (host-side fetch on the main
+    thread, pure-I/O per-process shard writes).  A saved step becomes a
+    finalized checkpoint via a filesystem rendezvous: the step boundary
+    piggybacks each host's save-done bit on the consensus vector and
+    calls :meth:`promote_up_to` with the agreed min; ``flush()``
+    additionally runs :meth:`finalize` (gather done-bits → process-0
+    promotion → barrier gather) so "durably on disk" means *finalized*,
+    not merely shard-written.  Both finalize gathers are main-thread
+    collectives issued at rendezvous points every host reaches together.
     """
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, coord: Optional[Coordinator] = None):
+        self._coord = coord
         use_async = bool(cfg.ckpt_dir) and getattr(cfg, "async_ckpt", True)
         if use_async and jax.process_count() > 1:
-            # The writer thread dispatches device work (finite-gate jit,
-            # save barrier) in a thread-scheduling-dependent order relative
-            # to the main thread's train-step collectives; multi-host JAX
-            # requires an identical collective launch order on every
-            # process (mismatch = deadlock).  Downgrade to the proven
-            # synchronous path — see async_ckpt.py module docstring.
-            log.warning(
-                "--async_ckpt is single-process only; multi-host run "
-                "falls back to synchronous checkpoint saves"
-            )
-            use_async = False
-        self._acp = AsyncCheckpointer() if use_async else None
+            self._acp = MultiHostAsyncCheckpointer()
+        elif use_async:
+            self._acp = AsyncCheckpointer()
+        else:
+            self._acp = None
 
     def save(self, ckpt_dir: str, step: int, state, **kwargs) -> None:
         self.save_multi([(ckpt_dir, kwargs)], step, state)
@@ -543,9 +603,50 @@ class _CkptPipeline:
         self.flush()
         return save_state(ckpt_dir, step, state, **kwargs)
 
+    def done_seq(self) -> int:
+        """This host's newest fully-written async save sequence (-1 when
+        not on the multi-host async path) — the boundary consensus
+        piggybacks it as the save-done bit."""
+        if isinstance(self._acp, MultiHostAsyncCheckpointer):
+            return self._acp.done_seq
+        return -1
+
+    def promote_up_to(self, agreed_seq: int) -> None:
+        """Finalize pending multi-host saves up to the consensus-agreed
+        sequence (process 0's filesystem rendezvous); no-op elsewhere."""
+        if isinstance(self._acp, MultiHostAsyncCheckpointer):
+            self._acp.promote_up_to(agreed_seq)
+
+    def finalize(self, raise_errors: bool = True) -> None:
+        """Multi-host finalization rendezvous: agree the promotion
+        frontier (min done-seq over hosts), promote on process 0, then
+        a second gather as the visibility barrier — after this returns,
+        every host's directory walk ranks the promoted step.  Collective
+        on multi-host: callers are rendezvous points all hosts reach
+        together (preempt exit, final save, rollback recovery)."""
+        acp = self._acp
+        if not isinstance(acp, MultiHostAsyncCheckpointer) or self._coord is None:
+            return
+        agreed = self._coord.agree_step(acp.done_seq)
+        acp.promote_up_to(agreed)
+        self._coord.agree_step(agreed)  # barrier: promotion now visible
+        if raise_errors:
+            acp.flush()  # surface any promotion failure at the rendezvous
+
     def flush(self) -> None:
-        if self._acp is not None:
-            self._acp.flush()
+        if self._acp is None:
+            return
+        if isinstance(self._acp, MultiHostAsyncCheckpointer):
+            # Collectives FIRST, raise LAST: a host-local writer error
+            # raised before the finalize gathers would leave the healthy
+            # hosts blocked in agree_step — with the watchdog masked at
+            # every flush call site, an unwatchable hang.  Join without
+            # raising, run the rendezvous in lockstep, then surface the
+            # error (finalize's own trailing flush raises it).
+            self._acp.join()
+            self.finalize(raise_errors=True)
+            return
+        self._acp.flush()
 
     def close(self, raise_errors: bool = True) -> None:
         if self._acp is not None:
@@ -841,8 +942,8 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     guard = _make_guard(cfg, logger)
     if guard:
         guard.prime(state)
-    ckpt = _CkptPipeline(cfg)
     coord = Coordinator()  # multi-host consensus; single-process: inert
+    ckpt = _CkptPipeline(cfg, coord)
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -853,13 +954,34 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     with contextlib.ExitStack() as _cleanup, PreemptionHandler(
         logger
     ) as preempt, HangWatchdog(
-        cfg.watchdog_timeout, cfg.ckpt_dir, logger
-    ) as wd:
+        cfg.watchdog_timeout, cfg.ckpt_dir, logger,
+        keep=getattr(cfg, "watchdog_keep", HangWatchdog.DEFAULT_KEEP),
+    ) as wd, NoticeWatcher(
+        getattr(cfg, "preempt_notice_file", None),
+        getattr(cfg, "preempt_notice_metadata", False),
+    ) as nw:
         # Abnormal-exit rendezvous: join (don't abandon) a live writer
         # thread; errors were already logged and must not mask the
         # original exception.  Normal paths flush explicitly first.
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
-        boundary = _StepBoundary(guard, preempt, coord, wd, logger)
+        boundary = _StepBoundary(
+            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw
+        )
+
+        def _proactive_save(st):
+            # Preemption notice: save NOW (all hosts, same boundary) and
+            # keep training — the later SIGTERM exits fast with this
+            # checkpoint already durable instead of spending its grace
+            # window writing a second one.
+            if not cfg.ckpt_dir:
+                return None
+            step = int(st.step)
+            with wd.suspended():  # save may legitimately outlast the timeout
+                ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
+            logger.log("notice_save", step, epoch=epoch, sync=True)
+            return step
+
+        boundary.on_notice = _proactive_save
         while epoch < cfg.epochs:
             source_iter = batch_iterator(
                 source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
@@ -954,8 +1076,13 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 # in-memory snapshot could still save the run.
                 with wd.suspended():  # writer join blocks on in-flight I/O
                     ckpt.close(raise_errors=False)
-                # UNMASKED on purpose: _rollback_state's consensus
-                # collectives (agree_step/assert_same) must stay
+                # Promote any writer-completed multi-host saves BEFORE the
+                # restore walk (all hosts reach this handler together, so
+                # the finalize gathers stay in lockstep); errors stay
+                # queued — a failed promotion must not abort recovery.
+                ckpt.finalize(raise_errors=False)
+                # UNMASKED on purpose: the finalize and _rollback_state's
+                # consensus collectives (agree_step/assert_same) must stay
                 # watchable — a peer dying mid-rollback would otherwise
                 # hang here forever with the watchdog blinded.  The
                 # timeout budgets a restore, exactly like the unmasked
@@ -990,15 +1117,38 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 # first (already logged): an old failed periodic save must
                 # not block the final save this exit-0 contract promises —
                 # only the final save's OWN failure may surface here.
+                resume_step = None
                 if cfg.ckpt_dir:
                     with wd.suspended():  # final save must not be killed
                         ckpt.close(raise_errors=False)
-                        ckpt.save(
-                            cfg.ckpt_dir, int(state.step), state,
-                            **_keep_kwargs(cfg),
-                        )
+                        # Trust but verify the notice-driven proactive
+                        # save before skipping the final one: its writer
+                        # may have FAILED (error just cleared above) —
+                        # finalize first (promotes a completed multi-host
+                        # save), then require a durably valid artifact,
+                        # or this exit-0 would advertise a checkpoint
+                        # that does not exist.
+                        ckpt.finalize(raise_errors=False)
+                        resume_step = boundary.notice_step
+                        if resume_step is not None and not is_valid_checkpoint(
+                            os.path.join(cfg.ckpt_dir, str(resume_step))
+                        ):
+                            resume_step = None
+                        if resume_step is None:
+                            ckpt.save(
+                                cfg.ckpt_dir, int(state.step), state,
+                                **_keep_kwargs(cfg),
+                            )
+                        # else: the proactive save is durable — the
+                        # grace window buys nothing from a second one.
                         ckpt.flush()
-                logger.log("preempt", int(state.step), epoch=epoch, sync=True)
+                logger.log(
+                    "preempt", int(state.step), epoch=epoch, sync=True,
+                    **(
+                        {"resume_step": resume_step}
+                        if resume_step is not None else {}
+                    ),
+                )
                 return acc
             result = evalp.evaluate(state, target_test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
@@ -1193,8 +1343,8 @@ def run_officehome(
     evalp = _make_eval_pipeline(cfg, build_model, mesh, num_domains=3)
 
     acc = 0.0
-    ckpt = _CkptPipeline(cfg)
     coord = Coordinator()  # multi-host consensus; single-process: inert
+    ckpt = _CkptPipeline(cfg, coord)
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -1261,11 +1411,30 @@ def run_officehome(
     with contextlib.ExitStack() as _cleanup, PreemptionHandler(
         logger
     ) as preempt, HangWatchdog(
-        cfg.watchdog_timeout, cfg.ckpt_dir, logger
-    ) as wd:
+        cfg.watchdog_timeout, cfg.ckpt_dir, logger,
+        keep=getattr(cfg, "watchdog_keep", HangWatchdog.DEFAULT_KEEP),
+    ) as wd, NoticeWatcher(
+        getattr(cfg, "preempt_notice_file", None),
+        getattr(cfg, "preempt_notice_metadata", False),
+    ) as nw:
         # Abnormal-exit rendezvous for the async writer (see run_digits).
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
-        boundary = _StepBoundary(guard, preempt, coord, wd, logger)
+        boundary = _StepBoundary(
+            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw
+        )
+
+        def _proactive_save(st):
+            # Notice-driven all-host save while training continues — see
+            # run_digits._proactive_save.
+            if not cfg.ckpt_dir:
+                return None
+            step = int(st.step)
+            with wd.suspended():
+                ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
+            logger.log("notice_save", step, sync=True)
+            return step
+
+        boundary.on_notice = _proactive_save
         # Rollback retry loop: each attempt builds fresh (re-seeded)
         # streams and trains from the current state; a RollbackRequest
         # restores the newest valid checkpoint and starts a new attempt.
@@ -1375,6 +1544,9 @@ def run_officehome(
                 # rollback: a stale writer error must not abort recovery).
                 with wd.suspended():  # writer join blocks on in-flight I/O
                     ckpt.close(raise_errors=False)
+                # Promote writer-completed multi-host saves before the
+                # restore walk (see run_digits rollback).
+                ckpt.finalize(raise_errors=False)
                 # Unmasked: the rollback consensus collectives must stay
                 # watchable (see run_digits).
                 state = _rollback_state(
@@ -1406,15 +1578,33 @@ def run_officehome(
             # step together.  Flush: the checkpoint must be durable
             # before the exit-0 return.  Stale writer errors are cleared
             # first (see run_digits).
+            resume_step = None
             if cfg.ckpt_dir:
                 with wd.suspended():  # final save must not be killed
                     ckpt.close(raise_errors=False)
-                    ckpt.save(
-                        cfg.ckpt_dir, int(state.step), state,
-                        **_keep_kwargs(cfg),
-                    )
+                    # Verify the proactive save is durable before
+                    # skipping the final one (see run_digits).
+                    ckpt.finalize(raise_errors=False)
+                    resume_step = boundary.notice_step
+                    if resume_step is not None and not is_valid_checkpoint(
+                        os.path.join(cfg.ckpt_dir, str(resume_step))
+                    ):
+                        resume_step = None
+                    if resume_step is None:
+                        ckpt.save(
+                            cfg.ckpt_dir, int(state.step), state,
+                            **_keep_kwargs(cfg),
+                        )
+                    # else: the proactive save is durable — exit fast,
+                    # no second checkpoint.
                     ckpt.flush()
-            logger.log("preempt", int(state.step), sync=True)
+            logger.log(
+                "preempt", int(state.step), sync=True,
+                **(
+                    {"resume_step": resume_step}
+                    if resume_step is not None else {}
+                ),
+            )
             return acc
         # Training done: surface any in-flight writer failure before the
         # stat-collection protocol spends more device time.  Masked: the

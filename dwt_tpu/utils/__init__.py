@@ -2,6 +2,7 @@
 
 from dwt_tpu.utils.metrics import MetricLogger
 from dwt_tpu.utils.checkpoint import (
+    is_valid_checkpoint,
     latest_step,
     restore_state,
     save_state,
@@ -16,6 +17,7 @@ from dwt_tpu.utils.repro import (
 
 __all__ = [
     "MetricLogger",
+    "is_valid_checkpoint",
     "latest_step",
     "restore_state",
     "save_state",

@@ -23,6 +23,27 @@ Checkpoint I/O additionally retries transient ``OSError`` with bounded
 exponential backoff (flaky NFS/GCS fuse mounts).  Directories without a
 manifest are accepted as legacy artifacts (pre-manifest converter output)
 — finalized-by-rename still guarantees they are complete.
+
+**Host-shard format (multi-host async saves, ISSUE-5).**  The Orbax path
+above is collective-bearing on multi-host (coordinated array writes + a
+cross-process barrier), which forbids running it off the main thread.
+The async pipeline therefore uses a second, collective-free on-disk
+format there: the main thread fetches the state host-side, and each
+process's writer thread — pure I/O — writes only its own replica under
+``.tmp-mh-<step>/shard_<proc>/`` (raw leaf bytes + a per-shard manifest
+with digest, dtypes, shapes, and file sizes).  Once every process
+reports its shard durably written (a bit piggybacked on the step-
+boundary consensus vector — see ``resilience/coord.py``), process 0
+*promotes* the step: validates all shard manifests, writes the top-level
+manifest (``format: host_shards``), and atomically renames the tmp dir
+to ``<step>``.  Rename-as-finalize keeps every existing guarantee: an
+unpromoted save is invisible to ``valid_steps`` (tmp prefix), a torn
+shard fails promotion, and restore walks straight past it to the
+newest *finalized* step.  ``restore_state`` reads either format
+transparently.  The format requires the state to be process-replicated
+(this repo's DP design: params/opt-state/stats are identical on every
+host) — ``host_fetch`` refuses leaves whose local shard is narrower
+than the global shape.
 """
 
 from __future__ import annotations
@@ -87,7 +108,9 @@ def params_digest(params: Any) -> str:
     return h.hexdigest()
 
 
-def _write_manifest(path: str, step: int, digest: str) -> None:
+def _write_manifest(
+    path: str, step: int, digest: str, extra: Optional[dict] = None
+) -> None:
     files = {}
     for sub, _, names in os.walk(path):
         for name in names:
@@ -99,6 +122,8 @@ def _write_manifest(path: str, step: int, digest: str) -> None:
         "timestamp": time.time(),
         "files": files,
     }
+    if extra:
+        manifest.update(extra)
     with open(os.path.join(path, MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
 
@@ -171,6 +196,23 @@ def _sweep_stale_tmp(root: str, keep_name: Optional[str] = None) -> None:
         shutil.rmtree(full, ignore_errors=True)
 
 
+def _finalize_rename(root: str, tmp: str, final: str, step: int) -> None:
+    """Atomically promote ``tmp`` to ``final``.  A same-step re-save never
+    opens a window with the old artifact deleted and the new one not yet
+    in place (a crash there would eat the newest — possibly only —
+    checkpoint): the old step is moved aside into the tmp namespace
+    (atomic rename), the new one finalized, then the aside dropped."""
+    if os.path.exists(final):
+        aside = os.path.join(root, f"{_TMP_PREFIX}replaced-{int(step)}")
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.replace(final, aside)
+        os.replace(tmp, final)
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        os.replace(tmp, final)
+
+
 def tree_all_finite(tree: Any) -> bool:
     """One fused device verdict: every floating/complex leaf is finite."""
     import jax.numpy as jnp
@@ -213,6 +255,14 @@ def save_state(
     all processes sync before returning so none races ahead to read
     ``latest_step`` before the rename.
     """
+    if jax.process_count() > 1:
+        # The Orbax multi-host save is collective-bearing (coordinated
+        # array writes + the closing barrier): it must never run on a
+        # checkpoint writer thread — that is what the host-shard format
+        # below exists for.
+        from dwt_tpu.resilience.coord import assert_not_writer_thread
+
+        assert_not_writer_thread(f"multi-host checkpoint save @{step}")
     if require_finite and not tree_all_finite(getattr(state, "params", state)):
         log.warning(
             "skipping checkpoint save @%d: non-finite params (a NaN "
@@ -247,22 +297,7 @@ def save_state(
             # Fault hook: a preemption/SIGKILL landing here leaves only the
             # unfinalized tmp dir — exactly what restore must survive.
             inject.maybe_crash_mid_save(step)
-            if os.path.exists(final):
-                # Same-step re-save: never open a window with the old
-                # artifact deleted and the new one not yet in place (a
-                # crash there would eat the newest — possibly only —
-                # checkpoint).  Move the old step aside into the tmp
-                # namespace (atomic rename), finalize, then drop the aside.
-                aside = os.path.join(
-                    root, f"{_TMP_PREFIX}replaced-{int(step)}"
-                )
-                if os.path.exists(aside):
-                    shutil.rmtree(aside)
-                os.replace(final, aside)
-                os.replace(tmp, final)
-                shutil.rmtree(aside, ignore_errors=True)
-            else:
-                os.replace(tmp, final)
+            _finalize_rename(root, tmp, final, step)
     except OSError:
         if primary:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -279,7 +314,305 @@ def save_state(
     return final
 
 
+# ------------------------------------------------------ host-shard format
+#
+# Collective-free on-disk format for multi-host async saves (module doc).
+# Layout:   <root>/.tmp-mh-<step>/shard_<proc>/leaves.bin  (raw leaf bytes)
+#                                             /shard_manifest.json
+# promoted: <root>/<step>/manifest.json  (format: host_shards) + shards.
+
+HOST_SHARD_FORMAT = "host_shards"
+SHARD_MANIFEST = "shard_manifest.json"
+_MH_TMP = _TMP_PREFIX + "mh-"  # still .tmp-* : invisible to valid_steps
+_LEAVES_FILE = "leaves.bin"
+
+
+def _mh_tmp_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"{_MH_TMP}{int(step)}")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a saved dtype name, including the ml_dtypes extended floats
+    (``np.dtype('bfloat16')`` raises; the class object resolves)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def host_fetch(state: Any) -> Any:
+    """Fetch ``state`` host-side as a pytree of numpy arrays (main thread).
+
+    Blocks until the leaves' producing computations finish — this is the
+    hot-path cost of a multi-host async save, and it is the WHOLE cost:
+    everything after it is pure I/O on the writer thread.  Multi-host
+    global arrays are read through their first addressable shard, which
+    requires the state to be process-replicated: a leaf whose local shard
+    is narrower than its global shape would silently save one host's
+    slice as if it were the world, so it raises instead.
+    """
+
+    def fetch(leaf):
+        if hasattr(leaf, "addressable_data") and not getattr(
+            leaf, "is_fully_addressable", True
+        ):
+            local = np.asarray(jax.device_get(leaf.addressable_data(0)))
+            if tuple(local.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    "host-shard checkpointing requires process-replicated "
+                    f"state; got a leaf with global shape {tuple(leaf.shape)} "
+                    f"but local shard {tuple(local.shape)}"
+                )
+            return local
+        return np.asarray(jax.device_get(leaf))
+
+    return jax.tree.map(fetch, state)
+
+
+def host_tree_all_finite(host_tree: Any) -> bool:
+    """Writer-thread finite gate: pure numpy, no device work.
+
+    ``np.isfinite`` is applied per dtype's own notion (the ml_dtypes
+    extended floats support it directly but are NOT ``np.floating``
+    subdtypes, so membership tests would silently skip them); integer
+    leaves are trivially finite and dtypes without the ufunc are passed.
+    """
+    for leaf in jax.tree_util.tree_leaves(host_tree):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind in "biu":
+            continue
+        try:
+            finite = bool(np.all(np.isfinite(arr)))
+        except TypeError:
+            continue
+        if not finite:
+            return False
+    return True
+
+
+def save_host_shard(
+    ckpt_dir: str, step: int, host_state: Any, process_index: int,
+    require_finite: bool = True,
+) -> bool:
+    """Write THIS process's replica of ``host_state`` (numpy leaves, from
+    :func:`host_fetch`) under ``.tmp-mh-<step>/shard_<process_index>``.
+
+    Pure I/O — safe on the checkpoint writer thread: raw leaf bytes into
+    one ``leaves.bin``, then the shard manifest (paths, dtypes, shapes,
+    offsets, params digest, file sizes) written LAST so a torn shard is
+    recognizable.  Returns False when ``require_finite`` refuses the save
+    (no artifact, mirroring ``save_state``'s None).  Promotion to a
+    finalized ``<step>`` directory is a separate, main-thread step —
+    :func:`promote_host_shards` — once every process's shard exists.
+    """
+    if require_finite and not host_tree_all_finite(
+        getattr(host_state, "params", host_state)
+    ):
+        log.warning(
+            "skipping host-shard save @%d: non-finite params (a NaN "
+            "checkpoint would poison newest-valid resume)", step,
+        )
+        return False
+    root = _root(ckpt_dir)
+    shard = os.path.join(_mh_tmp_dir(root, step), f"shard_{int(process_index)}")
+
+    def _write():
+        inject.maybe_io_error(f"host shard @{step}")
+        os.makedirs(shard, exist_ok=True)
+        flat = jax.tree_util.tree_flatten_with_path(host_state)[0]
+        leaves, offset = [], 0
+        with open(os.path.join(shard, _LEAVES_FILE), "wb") as f:
+            for path, leaf in flat:
+                # tobytes() emits C-order bytes for any layout; no
+                # ascontiguousarray (it promotes 0-d scalars to (1,)).
+                arr = np.asarray(leaf)
+                f.write(arr.tobytes())
+                leaves.append({
+                    "path": jax.tree_util.keystr(path),
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                    "offset": offset,
+                    "nbytes": int(arr.nbytes),
+                })
+                offset += arr.nbytes
+            f.flush()
+            os.fsync(f.fileno())
+        # Fault hook: a host dying HERE (bytes written, manifest not)
+        # leaves a torn shard that promotion must refuse — the previous
+        # finalized step stays authoritative.
+        inject.maybe_kill_writer_mid_shard(step)
+        manifest = {
+            "step": int(step),
+            "format": HOST_SHARD_FORMAT,
+            "process_index": int(process_index),
+            "params_digest": params_digest(
+                getattr(host_state, "params", host_state)
+            ),
+            "timestamp": time.time(),
+            "leaves": leaves,
+            "files": {_LEAVES_FILE: offset},
+        }
+        tmp_manifest = os.path.join(shard, SHARD_MANIFEST + ".tmp")
+        with open(tmp_manifest, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_manifest, os.path.join(shard, SHARD_MANIFEST))
+
+    _with_retries(_write, f"host-shard save @{step}")
+    return True
+
+
+def _read_shard_manifest(shard_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(shard_dir, SHARD_MANIFEST)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    for rel, size in manifest.get("files", {}).items():
+        full = os.path.join(shard_dir, rel)
+        if not os.path.exists(full) or os.path.getsize(full) != size:
+            return None
+    return manifest
+
+
+def promote_host_shards(
+    ckpt_dir: str, step: int, process_count: int, keep: Optional[int] = None,
+) -> str:
+    """Finalize ``.tmp-mh-<step>`` once all shards are durably written.
+
+    Process 0 only, main thread, pure filesystem: validates every shard's
+    manifest (existence + recorded sizes — a torn shard fails promotion
+    and the tmp dir is left for the stale sweep), writes the top-level
+    manifest, and atomically renames to ``<step>``.  The caller learns
+    "all shards written" from the consensus save-done bits, NOT from
+    polling here — so a missing shard at this point is a real fault, not
+    a race, and raises.  ``keep`` prunes the main dir afterwards, exactly
+    like a synchronous save.
+    """
+    root = _root(ckpt_dir)
+    tmp = _mh_tmp_dir(root, step)
+    final = os.path.join(root, str(int(step)))
+    if not os.path.isdir(tmp) and is_valid_checkpoint(final):
+        # Already promoted: a same-step save can be enqueued twice (a
+        # notice-driven proactive save coinciding with the cadence save),
+        # and the first promotion consumed the tmp dir.  Idempotent
+        # success, not a torn-shard error.
+        return final
+    digest = None
+    for p in range(int(process_count)):
+        shard_dir = os.path.join(tmp, f"shard_{p}")
+        manifest = _read_shard_manifest(shard_dir)
+        if manifest is None or int(manifest.get("step", -1)) != int(step):
+            raise OSError(
+                f"cannot promote checkpoint step {step}: shard_{p} is "
+                "missing or torn (its writer died mid-shard-write?) — the "
+                "previous finalized step stays authoritative"
+            )
+        if p == 0:
+            digest = manifest.get("params_digest")
+    _write_manifest(
+        tmp, step, digest,
+        extra={
+            "format": HOST_SHARD_FORMAT,
+            "process_count": int(process_count),
+        },
+    )
+    _finalize_rename(root, tmp, final, step)
+    _sweep_stale_tmp(root)
+    if keep is not None:
+        for old in valid_steps(root)[:-keep]:
+            shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+    return final
+
+
+def _restore_host_shards(path: str, template: Any, manifest: dict) -> Any:
+    """Rebuild ``template``'s pytree from a promoted host-shard checkpoint.
+
+    Reads this process's own shard when present (any shard holds the full
+    replica — the format requires process-replicated state), else shard 0
+    (a run resumed with a different process count).  Leaves are placed
+    with the template's sharding; non-fully-addressable templates (mid-
+    training DP state) go through ``make_array_from_callback`` — local,
+    collective-free placement.
+    """
+    mine = os.path.join(path, f"shard_{jax.process_index()}")
+    shard_dir = mine if os.path.isdir(mine) else os.path.join(path, "shard_0")
+    shard = _read_shard_manifest(shard_dir)
+    if shard is None:
+        raise ValueError(f"checkpoint {path}: shard manifest missing/torn")
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    entries = shard["leaves"]
+    if len(entries) != len(flat):
+        raise ValueError(
+            f"checkpoint {path} has {len(entries)} leaves; template "
+            f"expects {len(flat)} (structure mismatch)"
+        )
+    with open(os.path.join(shard_dir, _LEAVES_FILE), "rb") as f:
+        blob = f.read()
+    host_leaves = []
+    for (tpath, tleaf), entry in zip(flat, entries):
+        key = jax.tree_util.keystr(tpath)
+        if entry["path"] != key:
+            raise ValueError(
+                f"checkpoint {path}: leaf order mismatch at {key} "
+                f"(saved {entry['path']})"
+            )
+        arr = np.frombuffer(
+            blob, dtype=_np_dtype(entry["dtype"]),
+            count=int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"]
+            else 1,
+            offset=entry["offset"],
+        ).reshape(entry["shape"])
+        if tuple(arr.shape) != tuple(tleaf.shape):
+            raise ValueError(
+                f"checkpoint {path}: {key} has shape {tuple(arr.shape)}; "
+                f"template expects {tuple(tleaf.shape)}"
+            )
+        host_leaves.append(arr)
+    restored_host = jax.tree_util.tree_unflatten(
+        treedef, host_leaves
+    )
+    got = params_digest(getattr(restored_host, "params", restored_host))
+    want = shard.get("params_digest")
+    if want is not None and got != want:
+        raise ValueError(
+            f"checkpoint {path} failed shard digest validation "
+            f"({got[:12]}… != manifest {want[:12]}…)"
+        )
+
+    def place(arr, tleaf):
+        sharding = getattr(tleaf, "sharding", None)
+        if sharding is not None and not getattr(
+            tleaf, "is_fully_addressable", True
+        ):
+            # Mid-training template (rollback): the state lives on the
+            # global mesh — rebuild it there, collective-free (each
+            # process supplies its addressable shards from the replica).
+            return jax.make_array_from_callback(
+                tuple(arr.shape), sharding, lambda idx: arr[idx]
+            )
+        # Startup resume: return an UNCOMMITTED array (like fresh init).
+        # Pinning to the template's single local device would COMMIT it,
+        # and the multi-host sharded train step cannot implicitly reshard
+        # a committed process-local array onto the global mesh — the
+        # fresh-init path works exactly because init output is
+        # uncommitted, so restore must mirror it.
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [place(a, t) for a, (_, t) in zip(host_leaves, flat)]
+    )
+
+
 def _restore_one(path: str, template: Any) -> Any:
+    manifest = _read_manifest(path)
+    if manifest is not None and manifest.get("format") == HOST_SHARD_FORMAT:
+        return _restore_host_shards(path, template, manifest)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
 
     def _read():

@@ -93,24 +93,35 @@ def _assert_resumable(ck):
 
 
 def test_chaos_smoke_composed_faults_exit0_resumable(tmp_path):
-    """Fast tier-1 case, three fault kinds composed in ONE plan: a slow
+    """Fast tier-1 case, four fault kinds composed in ONE plan: a slow
     step (the watchdog must tolerate a transient stall), one flaky save
-    write (the retry ladder must absorb it), then SIGTERM at a step
-    boundary (the preemption path must save and exit 0).  Ends with a
-    validated, genuinely restorable checkpoint."""
+    write (the retry ladder must absorb it), a preemption NOTICE (the
+    scheduler's advance warning → proactive save while training
+    continues), then SIGTERM at a later boundary (the preemption path
+    must exit 0 FAST — the proactively saved checkpoint is the resume
+    source and no second checkpoint is written).  Ends with a validated,
+    genuinely restorable checkpoint."""
     rc, ck, jsonl, stderr = _run_digits(
         tmp_path,
         plan={
             "slow_step_at": 2, "slow_step_s": 0.3,
             "io_error_saves": 1,
+            "notice_at_step": 4,
             "sigterm_at_step": 6,
         },
         extra=("--epochs", "500", "--watchdog_timeout", "120"),
     )
     assert rc == 0, f"stderr tail: {stderr[-2000:]}"
+    assert "notice_save" in _kinds(jsonl)
     assert "preempt" in _kinds(jsonl)
     step = _assert_resumable(ck)
-    assert step == 6  # the boundary the SIGTERM landed on
+    assert step == 4  # the proactive notice save, NOT the SIGTERM boundary
+    # Fast exit: the SIGTERM path wrote no second checkpoint, and the
+    # preempt record names the proactive save as the resume source.
+    assert not os.path.isdir(os.path.join(ck, "6"))
+    recs = [json.loads(l) for l in open(jsonl).read().splitlines()]
+    pre = [r for r in recs if r["kind"] == "preempt"][-1]
+    assert pre["step"] == 6 and pre["resume_step"] == 4
 
     # Prove "resumable" end-to-end: an in-process relaunch restores the
     # artifact (epochs == already-trained epochs -> restore + eval only).
@@ -318,13 +329,154 @@ def test_chaos_two_process_consensus_sigterm_one_host(tmp_path):
     assert preempts[0] == preempts[1] == 3
 
     # ...and the coordinated checkpoint is ONE valid artifact at that
-    # step.  (Layout varies by runtime: with fully-replicated state some
-    # orbax/jax combinations write everything from process 0, others add
-    # per-process ocdbt shards — validity, not layout, is the contract.)
+    # step, in the collective-free host-shard format (multi-host async
+    # saves no longer downgrade to the Orbax barrier path — ISSUE-5).
     ck = tmp_path / "shared_ck"
     assert latest_step(str(ck)) == 3
     assert is_valid_checkpoint(str(ck / "3"))
-    assert (ck / "3" / "ocdbt.process_0").exists()
+    assert (ck / "3" / "shard_0").exists() and (ck / "3" / "shard_1").exists()
+    assert json.load(open(ck / "3" / "manifest.json"))["format"] == "host_shards"
+
+
+def _spawn_two_process_digits(tmp_path, rank_plans, extra=(), timeout=480):
+    """Launch the 2-process digits trainer (shared ckpt_dir, consensus
+    path); ``rank_plans[r]`` arms a fault plan in rank r's env only.
+    Returns ``[(returncode, output), ...]``; kill-on-timeout enforces the
+    no-hang contract from outside."""
+    port = _free_port()
+    procs, logs = [], []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PALLAS_AXON_POOL_IPS", inject.ENV_VAR)}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            DWT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            DWT_NUM_PROCESSES="2",
+            DWT_PROCESS_ID=str(rank),
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        if rank_plans.get(rank):
+            env[inject.ENV_VAR] = json.dumps(rank_plans[rank])
+        jsonl = str(tmp_path / f"metrics_{rank}.jsonl")
+        logs.append(jsonl)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+                    "--synthetic", "--synthetic_size", "64",
+                    "--distributed", "--data_parallel",
+                    "--group_size", "4",
+                    "--source_batch_size", "8",
+                    "--target_batch_size", "8",
+                    "--test_batch_size", "8",
+                    "--num_workers", "0",
+                    "--log_interval", "1",
+                    "--metrics_jsonl", jsonl,
+                    "--ckpt_dir", str(tmp_path / "shared_ck"),
+                    *extra,
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=REPO,
+            )
+        )
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(
+            "2-process chaos run hung — the one outcome the matrix forbids"
+        )
+    return results, logs
+
+
+@pytest.mark.slow
+def test_chaos_two_process_kill_mid_shard_resumes_previous_step(tmp_path):
+    """Acceptance: with multi-host async saves (shard format), SIGKILLing
+    one host mid-shard-write must leave the PREVIOUS finalized step as
+    the resume source — the torn shard's step never promotes, the
+    surviving host exits by watchdog (not a hang), and a 2-process
+    relaunch resumes both hosts from the finalized step and completes."""
+    # Phase 1: save every epoch (8 steps — 64 items / global batch 8);
+    # rank 1 dies inside its shard write of the step-16 save (epoch 2),
+    # after the first save (step 8) finalized at an epoch-2 boundary.
+    results, _ = _spawn_two_process_digits(
+        tmp_path,
+        {1: {"kill_writer_mid_shard": 16}},
+        extra=("--epochs", "500", "--ckpt_every_epochs", "1",
+               "--watchdog_timeout", "25"),
+    )
+    rcs = [rc for rc, _ in results]
+    assert rcs[1] == -9, f"rank 1 should die by SIGKILL, got {rcs[1]}"
+    # Rank 0 must NOT hang in the next allgather: the watchdog (or the
+    # distributed runtime noticing the dead peer) gets it out nonzero.
+    assert rcs[0] != 0, f"rank 0 exited 0 despite its dead peer"
+
+    ck = str(tmp_path / "shared_ck")
+    # Step 8 (epoch 1) was written by both hosts and promoted by the
+    # consensus save-done bits; step 16's shard_1 is torn, so it must
+    # never have finalized.
+    assert latest_step(ck) == 8
+    assert is_valid_checkpoint(os.path.join(ck, "8"))
+    assert not os.path.isdir(os.path.join(ck, "16"))
+    for d in os.listdir(ck):
+        if d.isdigit():
+            assert is_valid_checkpoint(os.path.join(ck, d)), (
+                f"torn finalized checkpoint {d}"
+            )
+
+    # Phase 2: relaunch BOTH hosts; they resume from the finalized step 8
+    # and complete 3 epochs (24 steps) cleanly.
+    results, logs = _spawn_two_process_digits(
+        tmp_path, {}, extra=("--epochs", "3", "--ckpt_every_epochs", "1"),
+    )
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"relaunch rank {rank} failed:\n{out[-3000:]}"
+    for path in logs:
+        recs = [json.loads(l) for l in open(path).read().splitlines()]
+        res = [r for r in recs if r["kind"] == "resume"]
+        assert res and res[-1]["step"] == 8, f"no step-8 resume in {path}"
+    assert latest_step(ck) == 24
+
+
+@pytest.mark.slow
+def test_chaos_two_process_notice_one_host_saves_all(tmp_path):
+    """Acceptance: a preemption notice visible on ONE host becomes an
+    all-host proactive save at the SAME step (consensus notice bit) while
+    training continues; the later SIGTERM (on the OTHER host) exits 0 on
+    both without writing a second checkpoint — the proactive save is the
+    resume source."""
+    results, logs = _spawn_two_process_digits(
+        tmp_path,
+        {0: {"notice_at_step": 3}, 1: {"sigterm_at_step": 6}},
+        extra=("--epochs", "500", "--ckpt_every_epochs", "1000"),
+    )
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{out[-3000:]}"
+    saves, stops = [], []
+    for path in logs:
+        recs = [json.loads(l) for l in open(path).read().splitlines()]
+        ns = [r for r in recs if r["kind"] == "notice_save"]
+        assert ns, f"no notice_save record in {path}"
+        saves.append(ns[-1]["step"])
+        pre = [r for r in recs if r["kind"] == "preempt"]
+        assert pre and pre[-1]["resume_step"] == 3
+        stops.append(pre[-1]["step"])
+    assert saves == [3, 3]  # both hosts saved the same step, together
+    assert stops[0] == stops[1] == 6
+    ck = str(tmp_path / "shared_ck")
+    assert latest_step(ck) == 3  # the proactive save, promoted
+    assert not os.path.isdir(os.path.join(ck, "6"))  # no second save
+    assert json.load(
+        open(os.path.join(ck, "3", "manifest.json"))
+    )["format"] == "host_shards"
 
 
 # ------------------------------------------------ FaultPlan spec parsing
@@ -340,6 +492,7 @@ def test_fault_plan_parses_composed_kinds(monkeypatch):
         "slow_step_at": 2, "slow_step_s": 0.5,
         "io_error_saves": 2, "crash_in_save": True,
         "corrupt_items": {"source": [5], "target": [1, 2]},
+        "notice_at_step": 5, "kill_writer_mid_shard": 8,
     }))
     plan = FaultPlan.from_env()
     assert plan.nan_at_step == [3, 4]
@@ -347,6 +500,8 @@ def test_fault_plan_parses_composed_kinds(monkeypatch):
     assert plan.slow_step_at == 2 and plan.slow_step_s == 0.5
     assert plan.io_error_saves == 2 and plan.crash_in_save is True
     assert plan.corrupt_items == {"source": [5], "target": [1, 2]}
+    assert plan.notice_at_step == 5
+    assert plan.kill_writer_mid_shard == 8
 
 
 def test_fault_plan_scalar_nan_stays_scalar(monkeypatch):
@@ -371,6 +526,13 @@ def test_fault_plan_scalar_nan_stays_scalar(monkeypatch):
         ({"crash_in_save": "yes"}, "true .* or an"),
         ({"corrupt_items": {"eval": [1]}}, "source"),
         ({"corrupt_items": [1, 2]}, "map a stream role"),
+        # The notice is an ADVANCE warning: a plan where it cannot fire
+        # before the SIGTERM proves nothing about the proactive save.
+        ({"notice_at_step": 6, "sigterm_at_step": 6}, "must precede"),
+        ({"notice_at_step": 9, "sigterm_at_step": 5}, "must precede"),
+        ({"notice_at_step": 0}, "never fire"),
+        ({"kill_writer_mid_shard": "yes"}, "true .* or an int"),
+        ({"kill_writer_mid_shard": 0}, "true .* or an int"),
     ],
 )
 def test_fault_plan_rejects_bad_specs(monkeypatch, spec, match):

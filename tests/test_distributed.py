@@ -127,4 +127,11 @@ def test_two_process_distributed_digits(tmp_path):
     ck = tmp_path / "shared_ck" / str(step)
     assert ck.is_dir(), f"no coordinated checkpoint at {ck}"
     assert is_valid_checkpoint(str(ck))
-    assert (ck / "ocdbt.process_0").exists()
+    # Multi-host async saves use the collective-free host-shard format
+    # (ISSUE-5): one replica per process, promoted by process 0 once the
+    # consensus says every shard is durable.  --no-async_ckpt would
+    # produce the coordinated Orbax layout instead.
+    assert (ck / "shard_0").exists() and (ck / "shard_1").exists()
+    manifest = json.load(open(ck / "manifest.json"))
+    assert manifest["format"] == "host_shards"
+    assert manifest["process_count"] == 2

@@ -288,6 +288,9 @@ def test_officehome_real_datapath_e2e(tmp_path):
     assert {"train", "test", "stat_collection", "final_test"} <= kinds
 
 
+@pytest.mark.slow  # ~46 s — visda is the OfficeHome machinery with
+# different constants; the officehome CLI tests (fast set) drive the
+# shared loop, and tier-1 budget (tools/t1_budget.py) forced this out.
 def test_visda_cli_defaults_and_smoke(tmp_path):
     from dwt_tpu.cli.visda import build_parser, main
 

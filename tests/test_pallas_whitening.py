@@ -133,7 +133,16 @@ def test_pallas_jit_composes():
     assert not np.allclose(np.asarray(new_stats.cov), 1.0)
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "dtype",
+    [
+        jnp.float32,
+        # ~30 s — the float32 arm pins model-level parity in the fast
+        # set; tier-1 budget (tools/t1_budget.py) moved the bf16 twin
+        # to the slow matrix.
+        pytest.param(jnp.bfloat16, marks=pytest.mark.slow),
+    ],
+)
 def test_model_level_pallas_parity(dtype):
     """use_pallas routes every DomainWhiten site through the kernels; the
     dual-branch LeNet must produce matching logits, gradients, and EMA'd

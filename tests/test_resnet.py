@@ -130,6 +130,9 @@ def test_whiten_false_ablates_all_whitening_sites():
     assert logits.shape == (3, 2, 7)
 
 
+@pytest.mark.slow  # ~49 s — remat is a pure jax.checkpoint wrapper;
+# the fast set still covers the remat flag's plumbing, and tier-1
+# budget (tools/t1_budget.py) forced the full numerics twin out.
 def test_remat_preserves_numerics():
     # jax.checkpoint must change memory, not math: same params, same batch,
     # same outputs and gradients (up to recompute float noise).

@@ -1,0 +1,74 @@
+"""tools/t1_budget.py contract: the tier-1 budget gate must trip BEFORE
+the suite hits its hard timeout, name the slowest tests, and treat a
+summary-less log (a run that died mid-flight) as a failure."""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+from t1_budget import main, parse_log  # noqa: E402
+
+_LOG_OK = """\
+........ [100%]
+============================= slowest 25 durations =============================
+101.50s call     tests/test_resilience.py::test_sigterm_saves_final
+44.81s call     tests/test_chaos.py::test_chaos_smoke
+0.30s setup    tests/test_nn.py::test_lenet
+=========== 207 passed, 2 skipped in 600.00s (0:10:00) ===========
+"""
+
+# The tier-1 recipe runs ``pytest -q``: same summary, no ==== rails.
+_LOG_OK_QUIET = """\
+........ [100%]
+============================= slowest 25 durations =============================
+44.81s call     tests/test_chaos.py::test_chaos_smoke
+231 passed, 2 skipped, 42 deselected in 684.83s (0:11:24)
+"""
+
+_LOG_OVER = _LOG_OK.replace("600.00s (0:10:00)", "800.25s (0:13:20)")
+
+
+def test_parse_log_extracts_wall_and_durations():
+    wall, durations = parse_log(_LOG_OK)
+    assert wall == 600.0
+    assert durations[0] == (101.5, "call", "tests/test_resilience.py::test_sigterm_saves_final")
+    assert len(durations) == 3
+
+
+def test_quiet_mode_summary_parses(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG_OK_QUIET)
+    assert main(["--log", str(log)]) == 0
+    record = json.loads(capsys.readouterr().out)
+    assert record["wall_s"] == 684.83 and not record["over_threshold"]
+
+
+def test_inside_budget_exits_zero(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG_OK)
+    assert main(["--log", str(log), "--budget", "870", "--frac", "0.8"]) == 0
+    record = json.loads(capsys.readouterr().out)
+    assert record["wall_s"] == 600.0 and not record["over_threshold"]
+    assert record["slowest"][0]["seconds"] == 101.5
+
+
+def test_over_threshold_exits_nonzero(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG_OVER)
+    assert main(["--log", str(log), "--budget", "870", "--frac", "0.8"]) == 3
+    record = json.loads(capsys.readouterr().out)
+    assert record["over_threshold"] and record["headroom_s"] < 0
+
+
+def test_dead_run_without_summary_is_a_failure(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text("collected 200 items\n....\nKilled\n")
+    assert main(["--log", str(log)]) == 2
+
+
+def test_missing_log_is_a_failure(tmp_path):
+    assert main(["--log", str(tmp_path / "absent.log")]) == 2

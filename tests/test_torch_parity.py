@@ -684,6 +684,9 @@ def _tied_tiny_resnet(seed=2, double=False):
     return tm, fm, {"params": params, "batch_stats": variables["batch_stats"]}
 
 
+@pytest.mark.slow  # ~85 s — the digits k-step trajectory (fast set)
+# pins the same re-tied per-step parity machinery; tier-1 budget
+# (tools/t1_budget.py) forced the heavier OfficeHome twin out.
 def test_kstep_officehome_trajectory_matches_torch_sgd():
     """k re-tied single steps of the OfficeHome recipe on the tied tiny
     ResNet: two-group SGD (head lr, backbone lr×0.1, momentum 0.9, L2 5e-4

@@ -24,6 +24,16 @@ Prints one JSON line:
 
 Acceptance gate for the ISSUE-2 pipeline: ``stall_reduction_x >= 5`` on
 CPU.  Run with ``JAX_PLATFORMS=cpu python tools/ckpt_bench.py``.
+
+``--processes 2`` (ISSUE-5) measures the MULTI-HOST arms on one machine:
+the parent respawns itself as N distributed ranks (loopback
+coordinator, the test harness's env-var convention) and rank 0 prints
+the record.  Sync there is the coordinated Orbax save (collective-
+bearing, barrier at the end — the path ``--async_ckpt`` used to
+downgrade to); async is the collective-free host-shard pipeline
+(``MultiHostAsyncCheckpointer``): the timed enqueue is snapshot +
+host-side fetch, the untimed join covers the pure-I/O shard write plus
+the consensus-driven promotion rendezvous.
 """
 
 import argparse
@@ -117,10 +127,73 @@ def bench_async(state, bump, ckpt_dir: str, saves: int, steps_between: int):
     return stalls, writer, state
 
 
+def bench_async_multihost(state, bump, ckpt_dir: str, saves: int,
+                          steps_between: int):
+    """Multi-host async arm: timed snapshot+host-fetch enqueue; untimed
+    writer join + finalization rendezvous (gather done-bits → process-0
+    promotion → barrier) so every timed enqueue starts quiescent."""
+    from dwt_tpu.resilience import Coordinator, MultiHostAsyncCheckpointer
+
+    coord = Coordinator()
+    acp = MultiHostAsyncCheckpointer()
+    stalls, writer = [], []
+    for k in range(saves):
+        state = _advance(state, bump, steps_between)
+        t0 = time.perf_counter()
+        acp.save(ckpt_dir, int(k + 1), state)
+        stalls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        acp.flush()
+        agreed = coord.agree_step(acp.done_seq)
+        acp.promote_up_to(agreed)
+        coord.agree_step(agreed)
+        writer.append(time.perf_counter() - t0)
+    return stalls, writer, state
+
+
+def _spawn_ranks(argv, processes: int) -> int:
+    """Parent mode: respawn this script as N loopback-distributed ranks;
+    forward rank 0's output (the JSON record)."""
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in range(processes):
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            DWT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            DWT_NUM_PROCESSES=str(processes),
+            DWT_PROCESS_ID=str(rank),
+            PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), *argv],
+            env=env,
+            stdout=subprocess.PIPE if rank else None,
+            text=bool(rank) or None,
+        ))
+    rc = 0
+    for rank, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=1800)
+        rc = rc or proc.returncode
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="per-save loop stall, sync vs async")
     p.add_argument("--model", choices=["lenet", "tiny-resnet"], default="lenet")
     p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--processes", type=int, default=1,
+                   help=">1: respawn as N loopback-distributed ranks and "
+                        "bench the MULTI-HOST arms (coordinated Orbax sync "
+                        "save vs collective-free host-shard async)")
     p.add_argument("--saves", type=int, default=6,
                    help="timed saves per mode (one shared untimed warmup "
                         "save runs first: Orbax lazily builds its type-"
@@ -132,13 +205,56 @@ def main(argv=None):
                    help="scratch directory (default: a fresh temp dir)")
     args = p.parse_args(argv)
 
+    worker_rank = os.environ.get("DWT_PROCESS_ID")
+    if args.processes > 1 and worker_rank is None:
+        return _spawn_ranks(
+            [a for a in (argv if argv is not None else sys.argv[1:])],
+            args.processes,
+        )
+    multihost = args.processes > 1
+    if multihost:
+        from dwt_tpu.parallel import initialize_distributed
+
+        initialize_distributed(
+            coordinator_address=os.environ["DWT_COORDINATOR_ADDRESS"],
+            num_processes=args.processes,
+            process_id=int(worker_rank),
+        )
+
     state, _ = build_state(args.model, args.batch)
+    if multihost:
+        # The loops' state lives on the global mesh (replicated): mirror
+        # that here, or the coordinated Orbax arm refuses host-local
+        # arrays and the shard arm wouldn't exercise the global-array
+        # fetch path.
+        import numpy as _np
+
+        import jax
+        from jax.experimental import multihost_utils
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(_np.array(jax.devices()), ("d",))
+        state = multihost_utils.host_local_array_to_global_array(
+            state, mesh, PartitionSpec()
+        )
     bump = make_busywork(state)
     state = bump(state)  # compile outside the timed region
 
+    # Multi-host ranks must share ONE scratch dir (the shared-ckpt_dir
+    # layout the pipeline coordinates over): derive it from the port so
+    # every rank of this bench — and only this bench — agrees on it.
+    # Only auto-created scratch is cleaned up afterwards; a user-supplied
+    # --ckpt_dir is left alone.
+    auto_scratch = args.ckpt_dir is None
+    if multihost and args.ckpt_dir is None:
+        port = os.environ["DWT_COORDINATOR_ADDRESS"].rsplit(":", 1)[-1]
+        args.ckpt_dir = os.path.join(
+            tempfile.gettempdir(), f"dwt_ckpt_bench_mh_{port}"
+        )
     scratch = args.ckpt_dir or tempfile.mkdtemp(prefix="dwt_ckpt_bench_")
     sync_dir = os.path.join(scratch, "sync")
     async_dir = os.path.join(scratch, "async")
+    primary = not multihost or int(worker_rank) == 0
     try:
         # One untimed warmup save (Orbax registry + XLA finite-check jit).
         from dwt_tpu.utils.checkpoint import save_state
@@ -148,14 +264,20 @@ def main(argv=None):
         sync_stalls, state = bench_sync(
             state, bump, sync_dir, args.saves, args.steps_between
         )
-        async_stalls, writer, state = bench_async(
-            state, bump, async_dir, args.saves, args.steps_between
-        )
+        if multihost:
+            async_stalls, writer, state = bench_async_multihost(
+                state, bump, async_dir, args.saves, args.steps_between
+            )
+        else:
+            async_stalls, writer, state = bench_async(
+                state, bump, async_dir, args.saves, args.steps_between
+            )
 
         sync_ms = statistics.median(sync_stalls) * 1e3
         async_ms = statistics.median(async_stalls) * 1e3
         record = {
             "model": args.model,
+            "processes": args.processes,
             "saves": args.saves,
             "steps_between": args.steps_between,
             "sync_save_ms": round(sync_ms, 3),
@@ -163,12 +285,15 @@ def main(argv=None):
             "stall_reduction_x": round(sync_ms / max(async_ms, 1e-9), 1),
             "async_writer_ms": round(statistics.median(writer) * 1e3, 3),
         }
-        print(json.dumps(record))
+        if primary:
+            print(json.dumps(record))
         return record
     finally:
-        if args.ckpt_dir is None:
+        if auto_scratch and primary:
             shutil.rmtree(scratch, ignore_errors=True)
 
 
 if __name__ == "__main__":
-    main()
+    out = main()
+    if isinstance(out, int):  # parent mode forwards the ranks' status
+        sys.exit(out)

@@ -1,0 +1,121 @@
+"""Tier-1 runtime-budget gate: fail BEFORE the suite fails the budget.
+
+The tier-1 verify command (ROADMAP.md) runs the fast test set under a
+hard ``timeout`` — a suite that creeps past it doesn't fail a test, it
+kills the whole run, which reads as an infrastructure flake instead of
+the slow test it actually is.  This tool parses the tier-1 pytest log
+(the ``tee /tmp/_t1.log`` in the verify recipe), prints the slowest
+tests from the ``--durations`` section, and exits nonzero once the
+suite's wall time exceeds a fraction (default 80%) of the budget — so
+the next heavy test gets slow-marked while there is still headroom,
+not after CI starts timing out.
+
+Usage (after the tier-1 run)::
+
+    python tools/t1_budget.py --log /tmp/_t1.log
+    python tools/t1_budget.py --log /tmp/_t1.log --budget 870 --frac 0.8
+
+Exit codes: 0 = inside budget; 3 = over the threshold; 2 = the log has
+no parsable summary line (the run died before pytest could report —
+treat as a failure, not a pass).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+# "==== 207 passed, 2 skipped in 795.43s (0:13:15) ====" — or, under
+# ``pytest -q`` (the tier-1 recipe), the same line WITHOUT the ==== rails:
+# "231 passed, 2 skipped, 42 deselected in 684.83s (0:11:24)".
+_SUMMARY_RE = re.compile(
+    r"^(?:=+ )?(?=.*\b(?:passed|failed|error|skipped|no tests ran)\b)"
+    r".*\bin ([0-9]+(?:\.[0-9]+)?)s(?: \([0-9:]+\))?(?: =+)?\s*$",
+    re.M,
+)
+# "12.34s call     tests/test_x.py::test_y" — the --durations section.
+_DURATION_RE = re.compile(
+    r"^([0-9]+(?:\.[0-9]+)?)s\s+(call|setup|teardown)\s+(\S+)"
+)
+
+
+def parse_log(text: str):
+    """``(wall_s or None, [(seconds, phase, test_id), ...] slowest-first)``."""
+    wall = None
+    for m in _SUMMARY_RE.finditer(text):
+        wall = float(m.group(1))  # keep the LAST summary line
+    durations = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line.strip())
+        if m:
+            durations.append((float(m.group(1)), m.group(2), m.group(3)))
+    durations.sort(reverse=True)
+    return wall, durations
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="tier-1 wall-time budget gate (parses the pytest log)"
+    )
+    p.add_argument("--log", default="/tmp/_t1.log",
+                   help="tier-1 pytest log (the verify recipe's tee target)")
+    p.add_argument("--budget", type=float, default=870.0,
+                   help="tier-1 hard timeout in seconds (ROADMAP verify)")
+    p.add_argument("--frac", type=float, default=0.8,
+                   help="fail once wall time exceeds this fraction of the "
+                        "budget — the early-warning margin")
+    p.add_argument("--top", type=int, default=10,
+                   help="slowest tests to print (needs --durations=N on "
+                        "the pytest command to be nonzero)")
+    args = p.parse_args(argv)
+
+    try:
+        with open(args.log) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"t1_budget: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+
+    wall, durations = parse_log(text)
+    if wall is None:
+        print(
+            f"t1_budget: no pytest summary line in {args.log} — the run "
+            "died before reporting; treating as over budget",
+            file=sys.stderr,
+        )
+        return 2
+
+    threshold = args.budget * args.frac
+    slowest = [
+        {"seconds": s, "phase": ph, "test": t}
+        for s, ph, t in durations[: args.top]
+    ]
+    print(json.dumps({
+        "wall_s": wall,
+        "budget_s": args.budget,
+        "threshold_s": round(threshold, 1),
+        "headroom_s": round(threshold - wall, 1),
+        "over_threshold": wall > threshold,
+        "slowest": slowest,
+    }, indent=1))
+    if not durations:
+        print(
+            "t1_budget: no --durations section in the log; add "
+            "--durations=25 to the pytest command to see which tests to "
+            "slow-mark", file=sys.stderr,
+        )
+    if wall > threshold:
+        print(
+            f"t1_budget: tier-1 wall time {wall:.0f}s exceeds "
+            f"{args.frac:.0%} of the {args.budget:.0f}s budget — "
+            "slow-mark the heaviest tests above before the timeout "
+            "starts killing CI runs", file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
