@@ -56,6 +56,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route whitening through the Pallas two-pass "
                         "kernels (single-chip; incompatible with "
                         "--data_parallel)")
+    p.add_argument("--whitener",
+                   choices=["cholesky", "newton_schulz", "swbn"],
+                   default=d.whitener,
+                   help="whitening numerics backend: cholesky (reference "
+                        "unrolled factorization, default), newton_schulz "
+                        "(fixed-K iteration of pure batched matmuls — "
+                        "MXU-native, batches across sites), swbn (online "
+                        "whitening-matrix tracking, no factorization — "
+                        "eval runs off running estimates, so "
+                        "--stat_collection_passes 0 collapses the eval "
+                        "cadence from ~11 dataset passes to ~1)")
+    p.add_argument("--apply_lowering",
+                   choices=["auto", "grouped", "blockdiag"],
+                   default=d.apply_lowering,
+                   help="force the whitening-apply matmul lowering; auto "
+                        "keeps the backend heuristic (CPU: blockdiag; "
+                        "TPU: blockdiag up to the DWT_APPLY_CROSSOVER_C "
+                        "channel crossover, default 128, then grouped)")
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")

@@ -43,6 +43,14 @@ class DigitsConfig:
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
+    # Whitening numerics backend (ops/whitening.py Whitener registry):
+    # "cholesky" (reference path, default), "newton_schulz" (fixed-K
+    # MXU-batched iteration), "swbn" (online whitening-matrix tracking).
+    whitener: str = "cholesky"
+    # Force the whitening-apply matmul lowering ("grouped"/"blockdiag");
+    # "auto" keeps the backend heuristic (TPU crossover env-tunable via
+    # DWT_APPLY_CROSSOVER_C, default 128).
+    apply_lowering: str = "auto"
     # >1: run k train steps per dispatch (lax.scan over k stacked
     # batches) — amortizes the per-dispatch host round-trip; numerics
     # match the single-step path (tests/test_train.py).
@@ -134,6 +142,13 @@ class OfficeHomeConfig:
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
+    # Whitening numerics backend — see DigitsConfig.whitener.  "swbn"
+    # additionally makes --stat_collection_passes 0 the intended eval
+    # cadence (~11 dataset passes per eval point → ~1).
+    whitener: str = "cholesky"
+    # Force the whitening-apply matmul lowering — see
+    # DigitsConfig.apply_lowering.
+    apply_lowering: str = "auto"
     # >1: k train steps per dispatch (lax.scan over k stacked batches);
     # chunks are cut at eval/checkpoint boundaries so the check_acc_step
     # and ckpt_every_iters cadences hold exactly.

@@ -47,6 +47,7 @@ class LeNetDWT(fnn.Module):
     axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False  # Pallas whitening kernels (single-chip)
+    whitener: str = "cholesky"  # whitening numerics backend (--whitener)
 
     def _norm(self, x, norm, train):
         return apply_domain_norm(x, norm, train, self.num_domains)
@@ -78,7 +79,8 @@ class LeNetDWT(fnn.Module):
             x,
             DomainWhiten(
                 32, self.group_size, eps=self.whiten_eps, name="dn1",
-                use_pallas=self.use_pallas, **norm_kw
+                use_pallas=self.use_pallas, whitener=self.whitener,
+                **norm_kw
             ),
             train,
         )
@@ -91,7 +93,8 @@ class LeNetDWT(fnn.Module):
             x,
             DomainWhiten(
                 48, self.group_size, eps=self.whiten_eps, name="dn2",
-                use_pallas=self.use_pallas, **norm_kw
+                use_pallas=self.use_pallas, whitener=self.whitener,
+                **norm_kw
             ),
             train,
         )

@@ -24,10 +24,11 @@ from flax import linen as fnn
 
 from dwt_tpu.ops.batch_norm import BatchNormStats, batch_norm, init_batch_norm_stats
 from dwt_tpu.ops.whitening import (
+    WHITEN_CACHE_COL,
     AxisName,
     WhiteningStats,
+    get_whitener,
     group_whiten,
-    init_whitening_stats,
 )
 
 
@@ -83,16 +84,27 @@ class DomainWhiten(fnn.Module):
     # Single-chip only: the kernel has no cross-replica moment pmean, so it
     # cannot be combined with ``axis_name`` (data parallelism).
     use_pallas: bool = False
+    # Numerics backend (--whitener): cholesky | newton_schulz | swbn.
+    # Stats structure follows the backend (swbn adds the tracked matrix),
+    # so checkpoints are per-backend artifacts.
+    whitener: str = "cholesky"
 
     @fnn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        whitener = get_whitener(self.whitener)
         if self.use_pallas and self.axis_name is not None:
             raise ValueError(
                 "DomainWhiten(use_pallas=True) is single-chip: the Pallas "
                 "kernel computes local moments only and cannot reproduce "
                 "the cross-replica pmean that axis_name requires"
             )
-        proto = init_whitening_stats(self.features, self.group_size)
+        if self.use_pallas and whitener.matrix_from_cov is None:
+            raise ValueError(
+                "DomainWhiten(use_pallas=True) supports factorizing "
+                "whiteners only: the Pallas seam has no online "
+                f"whitening-matrix state update ({self.whitener!r})"
+            )
+        proto = whitener.init_stats(self.features, self.group_size)
         stats_var = self.variable(
             "batch_stats",
             "whitening",
@@ -117,6 +129,7 @@ class DomainWhiten(fnn.Module):
                         train=True,
                         momentum=self.momentum,
                         eps=self.eps,
+                        whitener=self.whitener,
                     )
                     for d in range(self.num_domains)
                 ]
@@ -132,6 +145,7 @@ class DomainWhiten(fnn.Module):
                     momentum=self.momentum,
                     eps=self.eps,
                     axis_name=self.axis_name,
+                    whitener=whitener,
                 )
                 y, new_stats = jax.vmap(whiten)(x, stats)
             if not self.is_initializing():
@@ -147,14 +161,25 @@ class DomainWhiten(fnn.Module):
                     group_size=self.group_size,
                     train=False,
                     eps=self.eps,
+                    whitener=self.whitener,
                 )
             else:
+                # Once-per-pass precomputed eval matrix (ops.whitening.
+                # build_whiten_cache, threaded by EvalPipeline); absent →
+                # factorize from the running stats as before.
+                cached = (
+                    self.get_variable(WHITEN_CACHE_COL, "w")
+                    if self.has_variable(WHITEN_CACHE_COL, "w")
+                    else None
+                )
                 y, _ = group_whiten(
                     x,
                     branch,
                     group_size=self.group_size,
                     train=False,
                     eps=self.eps,
+                    whitener=whitener,
+                    eval_matrix=cached,
                 )
 
         if self.use_affine:

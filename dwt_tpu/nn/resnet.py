@@ -59,6 +59,7 @@ class BottleneckDWT(fnn.Module):
     axis_name: Optional[AxisName] = None
     dtype: jnp.dtype = jnp.float32
     use_pallas: bool = False  # Pallas whitening kernels (single-chip)
+    whitener: str = "cholesky"  # whitening numerics backend (--whitener)
 
     expansion: int = 4
 
@@ -72,7 +73,8 @@ class BottleneckDWT(fnn.Module):
         )
         if self.use_whitening:
             return DomainWhiten(
-                features, self.group_size, use_pallas=self.use_pallas, **kw
+                features, self.group_size, use_pallas=self.use_pallas,
+                whitener=self.whitener, **kw
             )
         return DomainBatchNorm(features, **kw)
 
@@ -139,6 +141,7 @@ class ResNetDWT(fnn.Module):
     # activations — the standard HBM lever for larger per-chip batches.
     remat: bool = False
     use_pallas: bool = False  # Pallas whitening kernels (single-chip)
+    whitener: str = "cholesky"  # whitening numerics backend (--whitener)
 
     @classmethod
     def resnet50(cls, **kw) -> "ResNetDWT":
@@ -183,7 +186,8 @@ class ResNetDWT(fnn.Module):
         x = apply_domain_norm(
             x,
             DomainWhiten(
-                64, self.group_size, use_pallas=self.use_pallas, **stem_kw
+                64, self.group_size, use_pallas=self.use_pallas,
+                whitener=self.whitener, **stem_kw
             )
             if self.whiten
             else DomainBatchNorm(64, **stem_kw),
@@ -216,6 +220,7 @@ class ResNetDWT(fnn.Module):
                     axis_name=self.axis_name,
                     dtype=self.dtype,
                     use_pallas=self.use_pallas,
+                    whitener=self.whitener,
                     name=f"layer{stage}_{block}",
                 )(x, train)
 

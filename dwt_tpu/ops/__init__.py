@@ -1,12 +1,18 @@
 """Functional compute ops (pure, jit-able, differentiable)."""
 
 from dwt_tpu.ops.whitening import (  # noqa: F401
+    WHITENER_NAMES,
+    SWBNStats,
+    Whitener,
     WhiteningStats,
-    init_whitening_stats,
-    group_whiten,
-    group_cov,
-    whitening_matrix,
     apply_whitening,
+    build_whiten_cache,
+    get_whitener,
+    group_cov,
+    group_whiten,
+    init_whitening_stats,
+    newton_schulz_inverse_sqrt,
+    whitening_matrix,
 )
 from dwt_tpu.ops.pallas_whitening import (  # noqa: F401
     pallas_group_whiten,

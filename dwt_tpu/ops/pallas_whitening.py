@@ -44,7 +44,7 @@ from dwt_tpu.ops.whitening import (
     WhiteningStats,
     _resolve_groups,
     _shrink,
-    whitening_matrix,
+    get_whitener,
 )
 
 try:  # pallas is TPU-oriented; import lazily-tolerant for exotic builds
@@ -190,43 +190,53 @@ def _apply_call(
 # ------------------------------------------------- differentiable train path
 
 
-def _pure_train_y(x2d, group_size, eps):
+def _pure_train_y(x2d, group_size, eps, whitener):
     """XLA-op forward (y only) used for the recompute VJP.
 
     Delegates to ``group_whiten`` itself (train-mode y is independent of
     the incoming stats) so the backward can never drift from the XLA
     path's numerics."""
-    from dwt_tpu.ops.whitening import group_whiten, init_whitening_stats
+    from dwt_tpu.ops.whitening import group_whiten
 
     c = x2d.shape[-1]
     y, _ = group_whiten(
         x2d,
-        init_whitening_stats(c, group_size),
+        whitener.init_stats(c, group_size),
         group_size=group_size,
         train=True,
         eps=eps,
+        whitener=whitener,
     )
     return y
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def _train_whiten(x2d, group_size, eps, interpret):
+# The whitener rides the nondiff slots as the resolved INSTANCE (hashable
+# by identity; registry names resolve to singletons) so a configured
+# backend — e.g. NewtonSchulzWhitener(num_iters=2) — uses the same
+# numerics in the train factorization, the recompute VJP, and eval.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _train_whiten(x2d, group_size, eps, interpret, whitener):
     num_groups, g = _resolve_groups(x2d.shape[-1], group_size)
     mean, cov = _moments_call(x2d, num_groups, g, interpret)
-    w = whitening_matrix(_shrink(cov, eps))
+    # Factorization stays plain JAX (see module docstring) — which is the
+    # pluggable seam: any factorizing backend slots in here, Mosaic never
+    # sees it (lowering pinned off-chip by tests/test_pallas_whitening.py).
+    w = whitener.matrix_from_cov(_shrink(cov, eps))
     y = _apply_call(x2d, mean, w, interpret)
     return y, mean, cov
 
 
-def _train_whiten_fwd(x2d, group_size, eps, interpret):
-    out = _train_whiten(x2d, group_size, eps, interpret)
+def _train_whiten_fwd(x2d, group_size, eps, interpret, whitener):
+    out = _train_whiten(x2d, group_size, eps, interpret, whitener)
     return out, (x2d,)
 
 
-def _train_whiten_bwd(group_size, eps, interpret, res, cots):
+def _train_whiten_bwd(group_size, eps, interpret, whitener, res, cots):
     (x2d,) = res
     gy, _, _ = cots  # mean/cov cotangents are zero (EMA is stop-gradient)
-    _, vjp = jax.vjp(lambda x: _pure_train_y(x, group_size, eps), x2d)
+    _, vjp = jax.vjp(
+        lambda x: _pure_train_y(x, group_size, eps, whitener), x2d
+    )
     (dx,) = vjp(gy.astype(x2d.dtype))
     return (dx,)
 
@@ -246,6 +256,7 @@ def pallas_group_whiten(
     momentum: float = 0.1,
     eps: float = 1e-3,
     interpret: Optional[bool] = None,
+    whitener="cholesky",  # registry name or a Whitener instance
 ) -> Tuple[jax.Array, WhiteningStats]:
     """Drop-in for :func:`dwt_tpu.ops.whitening.group_whiten` (single-chip).
 
@@ -253,28 +264,29 @@ def pallas_group_whiten(
     parallelism the moment pmean couples replicas, so sharded models keep
     the XLA op (whose moments pmean inside shard_map).  ``interpret``
     defaults to auto: compiled on TPU, interpreter elsewhere (tests).
+    ``whitener`` selects the factorization backend (factorizing backends
+    only — swbn's online matrix update has no Pallas seam).
     """
     if not HAS_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable in this jax build")
+    wh = get_whitener(whitener)
+    if wh.matrix_from_cov is None:
+        raise ValueError(
+            f"pallas_group_whiten supports factorizing whiteners only, "
+            f"not {wh.name!r}"
+        )
     interpret = _auto_interpret() if interpret is None else interpret
     num_features = x.shape[-1]
     num_groups, g = _resolve_groups(num_features, group_size)
     x2d = x.reshape(-1, num_features)
 
     if train:
-        y2, mean, cov = _train_whiten(x2d, g, eps, interpret)
-        new_stats = WhiteningStats(
-            mean=(
-                momentum * lax.stop_gradient(mean)
-                + (1.0 - momentum) * stats.mean
-            ),
-            cov=(
-                momentum * lax.stop_gradient(cov)
-                + (1.0 - momentum) * stats.cov
-            ),
+        y2, mean, cov = _train_whiten(x2d, g, eps, interpret, wh)
+        return (
+            y2.reshape(x.shape),
+            wh.update_stats(stats, mean, cov, momentum, None),
         )
-        return y2.reshape(x.shape), new_stats
 
-    w = whitening_matrix(_shrink(stats.cov.astype(jnp.float32), eps))
+    w = wh.eval_matrix(stats, eps, jnp.float32)
     y2 = _apply_call(x2d, stats.mean, w, interpret)
     return y2.reshape(x.shape), stats

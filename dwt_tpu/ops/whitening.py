@@ -30,11 +30,22 @@ Semantics matched to the reference (see tests/test_whitening.py):
   EMA update is detached from the gradient graph;
 * gradients flow through the batch moments and the Cholesky factorization in
   training mode (``cholesky``/``solve_triangular`` both have JVP rules).
+
+Numerics are PLUGGABLE (``--whitener``): the factorization/state rules live
+behind the :class:`Whitener` interface — ``cholesky`` (the reference path
+above, default, traced op-for-op unchanged), ``newton_schulz`` (fixed-K
+coupled Newton–Schulz ``Σ^{-1/2}`` as pure batched matmuls, arXiv:1804.08450),
+and ``swbn`` (online whitening-matrix tracking, no factorization at all,
+arXiv:2106.04413).  Moments, cross-replica pmean, EMA, and the apply matmul
+are shared by all backends.  :func:`build_whiten_cache` precomputes every
+site's eval matrix from frozen running stats in one site-stacked batch —
+eval passes factorize once per PASS, not once per site per batch.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple, Union
+import os
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -201,6 +212,100 @@ def whitening_matrix(cov_shrunk: jax.Array) -> jax.Array:
     return solve_triangular(chol, eye, lower=True)
 
 
+# Fixed Newton–Schulz iteration count (Decorrelated BN, arXiv:1804.08450,
+# uses T=5); env-overridable for the bench's iteration-count sweeps.
+_NS_ITERS_ENV = "DWT_NS_ITERS"
+_NS_DEFAULT_ITERS = 5
+
+
+def ns_default_iters() -> int:
+    value = os.environ.get(_NS_ITERS_ENV, "")
+    try:
+        return int(value) if value else _NS_DEFAULT_ITERS
+    except ValueError:
+        raise ValueError(f"{_NS_ITERS_ENV}={value!r} is not an integer") from None
+
+
+def _mm_small_unrolled(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched ``[..., g, g] @ [..., g, g]`` as ``g^3`` fused vector
+    multiply-adds (g is a compile-time constant).
+
+    The BLAS/dot lowering of tiny batched matmuls is a strided loop of
+    ~1.5 µs GEMM calls on CPU — the same pathology the block-diagonal
+    apply lowering dodges — while this form is pure elementwise work XLA
+    vectorizes over the batch.  Accumulation order matches the dot's
+    contraction order (ascending j), so results agree to FMA-level ulps.
+    """
+    g = a.shape[-1]
+    cols = []
+    for k in range(g):
+        acc = None
+        for j in range(g):
+            term = a[..., :, j] * b[..., j, k][..., None]
+            acc = term if acc is None else acc + term
+        cols.append(acc)
+    return jnp.stack(cols, axis=-1)
+
+
+# Tiny-matmul lowering for the iterative whiteners: "dot" (real batched
+# matmuls — the TPU/MXU path), "unrolled" (elementwise — the CPU path),
+# or "auto" (backend heuristic).  Env-overridable so the chip round can
+# A/B the MXU dot against the VPU-friendly unrolled form (PERF.md).
+_NS_MM_ENV = "DWT_NS_MM"
+
+
+def _small_matmul_fn(g: int, dtype):
+    mode = os.environ.get(_NS_MM_ENV, "auto")
+    if mode not in ("auto", "dot", "unrolled"):
+        raise ValueError(f"{_NS_MM_ENV}={mode!r}: use auto|dot|unrolled")
+    if mode == "auto":
+        mode = (
+            "unrolled"
+            if jax.default_backend() == "cpu" and g <= _UNROLL_MAX_G
+            else "dot"
+        )
+    if mode == "unrolled" and g <= _UNROLL_MAX_G:
+        return _mm_small_unrolled
+    # HIGHEST precision: statistics feeding a whitening transform must
+    # not ride the TPU's default bf16 multiply passes (see group_cov).
+    return lambda p, q: jnp.matmul(
+        p, q, precision=lax.Precision.HIGHEST, preferred_element_type=dtype
+    )
+
+
+def newton_schulz_inverse_sqrt(
+    a: jax.Array, num_iters: Optional[int] = None
+) -> jax.Array:
+    """``Σ^{-1/2}`` of batched SPD ``[..., g, g]`` by coupled Newton–Schulz.
+
+    Pure batched matmuls — the MXU-native replacement for the per-group
+    Cholesky + triangular-solve chain (Decorrelated BN, arXiv:1804.08450).
+    Unlike triangular solves, the iteration batches over ANY leading shape,
+    so all S sites' ``[G, g, g]`` covariances can stack into one
+    ``[S·G, g, g]`` call (see :func:`build_whiten_cache`).
+
+    Trace pre-scaling drives convergence: ``A/tr(A)`` has spectrum in
+    (0, 1], inside the iteration's basin, including from the all-ones
+    (rank-1) shrunk covariance init the reference uses.  Matmuls run at
+    HIGHEST precision — statistics feeding a whitening transform must not
+    ride the TPU's default bf16 multiply passes (same rule as group_cov).
+    """
+    if num_iters is None:
+        num_iters = ns_default_iters()
+    g = a.shape[-1]
+    eye = jnp.eye(g, dtype=a.dtype)
+    tr = jnp.trace(a, axis1=-2, axis2=-1)[..., None, None]
+    y = a / tr
+    z = jnp.broadcast_to(eye, a.shape)
+    mm = _small_matmul_fn(g, a.dtype)
+    for _ in range(num_iters):
+        t = 1.5 * eye - 0.5 * mm(z, y)
+        y = mm(y, t)
+        z = mm(t, z)
+    # z ≈ (A/tr)^{-1/2}; undo the pre-scaling.
+    return z / jnp.sqrt(tr)
+
+
 def _block_diag_expand(w: jax.Array) -> jax.Array:
     """``[G, g, g]`` per-group matrices -> one ``[C, C]`` block-diagonal
     matrix (C = G*g) with ``B[(g,c),(h,d)] = w[h,d,c] * (g == h)``, so that
@@ -211,8 +316,49 @@ def _block_diag_expand(w: jax.Array) -> jax.Array:
     return jnp.einsum("hdc,gh->gchd", w, eye).reshape(G * g, G * g)
 
 
+APPLY_LOWERINGS = ("auto", "grouped", "blockdiag")
+
+# Process-wide default for apply_whitening's ``lowering`` when callers do
+# not pass one: the CLI flag (--apply_lowering via set_default_apply_lowering)
+# wins, then the DWT_APPLY_LOWERING env var, then "auto".
+_APPLY_LOWERING_DEFAULT: Optional[str] = None
+
+# The "auto" TPU crossover between the block-diagonal and grouped apply
+# lowerings, overridable without a code edit so the pallas_bench A/B can be
+# replayed at other crossovers on-chip (PERF.md "Whitener numerics").
+_APPLY_CROSSOVER_ENV = "DWT_APPLY_CROSSOVER_C"
+_APPLY_CROSSOVER_DEFAULT = 128
+
+
+def set_default_apply_lowering(mode: Optional[str]) -> None:
+    """Set the process default apply lowering (``--apply_lowering``);
+    ``None``/"auto" restores the built-in auto heuristic."""
+    global _APPLY_LOWERING_DEFAULT
+    if mode is not None and mode not in APPLY_LOWERINGS:
+        raise ValueError(f"unknown apply lowering: {mode!r}")
+    _APPLY_LOWERING_DEFAULT = mode
+
+
+def default_apply_lowering() -> str:
+    if _APPLY_LOWERING_DEFAULT is not None:
+        return _APPLY_LOWERING_DEFAULT
+    return os.environ.get("DWT_APPLY_LOWERING", "auto")
+
+
+def apply_crossover_c() -> int:
+    """The auto heuristic's blockdiag→grouped channel crossover on TPU."""
+    value = os.environ.get(_APPLY_CROSSOVER_ENV, "")
+    try:
+        return int(value) if value else _APPLY_CROSSOVER_DEFAULT
+    except ValueError:
+        raise ValueError(
+            f"{_APPLY_CROSSOVER_ENV}={value!r} is not an integer"
+        ) from None
+
+
 def apply_whitening(
-    xn: jax.Array, w: jax.Array, compute_dtype=None, lowering: str = "auto"
+    xn: jax.Array, w: jax.Array, compute_dtype=None,
+    lowering: Optional[str] = None,
 ) -> jax.Array:
     """Apply per-group whitening matrix ``w [G, g, g]`` to centered ``xn``.
 
@@ -229,7 +375,9 @@ def apply_whitening(
     shape = xn.shape
     num_groups, group_size = w.shape[0], w.shape[1]
     C = num_groups * group_size
-    if lowering not in ("auto", "grouped", "blockdiag"):
+    if lowering is None:
+        lowering = default_apply_lowering()
+    if lowering not in APPLY_LOWERINGS:
         raise ValueError(f"unknown apply lowering: {lowering!r}")
     if lowering == "auto":
         # The grouped einsum contracts over only g (4) channels — a shape
@@ -244,7 +392,7 @@ def apply_whitening(
         if jax.default_backend() == "cpu":
             lowering = "blockdiag"
         else:
-            lowering = "blockdiag" if C <= 128 else "grouped"
+            lowering = "blockdiag" if C <= apply_crossover_c() else "grouped"
     if lowering == "blockdiag":
         t = xn.reshape(-1, C).astype(compute_dtype)
         B = _block_diag_expand(w).astype(compute_dtype)
@@ -260,6 +408,209 @@ def apply_whitening(
     return y.reshape(shape).astype(xn.dtype)
 
 
+# --------------------------------------------------------------- whiteners
+#
+# One numerics backend = one Whitener: how a whitening matrix is produced
+# from (batch or running) statistics, and what per-site state it carries.
+# Everything else — moment computation, cross-replica pmean, EMA momentum,
+# the apply matmul, the Flax site plumbing — is shared, so backends swap
+# via ``--whitener`` without touching the models or the loops.
+
+
+class SWBNStats(NamedTuple):
+    """Running state for one ``swbn`` whitening site.
+
+    mean/cov: the shared EMA plumbing (same convention as WhiteningStats).
+    w: ``[G, g, g]`` float32 online whitening matrix for the TRACE-
+    NORMALIZED covariance (``Σ/tr_g``); the apply-time matrix is
+    ``w / sqrt(tr_g)`` so the tracker's fixed-point spectrum stays O(1)
+    regardless of the sites' activation scale.
+    """
+
+    mean: jax.Array
+    cov: jax.Array
+    w: jax.Array
+
+
+class Whitener:
+    """Numerics backend behind :func:`group_whiten` (``--whitener``).
+
+    ``matrix_from_cov`` (when not None) maps batched shrunk covariances
+    ``[..., g, g]`` to whitening matrices — batched over any leading
+    shape, which is what lets :func:`build_whiten_cache` stack every
+    site's groups into ONE factorization call.  Backends with online
+    state (swbn) instead override ``train_matrix``/``update_stats``/
+    ``eval_matrix`` directly.
+    """
+
+    name: str = "base"
+    # False → eval runs off running estimates alone; the OfficeHome
+    # 10-pass stat re-estimation protocol buys nothing and
+    # ``--stat_collection_passes 0`` is the intended cadence.
+    needs_stat_collection: bool = True
+    matrix_from_cov = None  # overridden by factorizing backends
+
+    def init_stats(self, num_features: int, group_size: int, dtype=jnp.float32):
+        return init_whitening_stats(num_features, group_size, dtype)
+
+    def train_matrix(
+        self, cov: jax.Array, stats, eps: float
+    ) -> Tuple[jax.Array, Any]:
+        """``(apply matrix, aux state)`` from the batch covariance."""
+        return self.matrix_from_cov(_shrink(cov, eps)), None
+
+    def update_stats(self, stats, m, cov, momentum: float, aux):
+        """EMA update — the reference's convention, detached (see module
+        docstring); backends with extra state extend this."""
+        return WhiteningStats(
+            mean=(
+                momentum * lax.stop_gradient(m)
+                + (1.0 - momentum) * stats.mean
+            ),
+            cov=(
+                momentum * lax.stop_gradient(cov)
+                + (1.0 - momentum) * stats.cov
+            ),
+        )
+
+    def eval_matrix(self, stats, eps: float, dtype=jnp.float32) -> jax.Array:
+        return self.matrix_from_cov(_shrink(stats.cov.astype(dtype), eps))
+
+
+class CholeskyWhitener(Whitener):
+    """The reference numerics: unrolled Cholesky + triangular inverse.
+
+    The default backend; its traced ops are EXACTLY the pre-refactor
+    ``group_whiten`` path (pinned bitwise by tests/goldens)."""
+
+    name = "cholesky"
+
+    @staticmethod
+    def matrix_from_cov(cov_shrunk: jax.Array) -> jax.Array:
+        return whitening_matrix(cov_shrunk)
+
+
+class NewtonSchulzWhitener(Whitener):
+    """Fixed-K coupled Newton–Schulz ``Σ^{-1/2}`` (arXiv:1804.08450).
+
+    ZCA-flavored (symmetric) whitening out of pure batched matmuls: no
+    per-group sequential solve chain, and the factorization batches
+    across sites (``[S·G, g, g]``) where triangular solves cannot.
+    """
+
+    name = "newton_schulz"
+
+    def __init__(self, num_iters: Optional[int] = None):
+        self.num_iters = num_iters
+
+    def matrix_from_cov(self, cov_shrunk: jax.Array) -> jax.Array:
+        return newton_schulz_inverse_sqrt(cov_shrunk, self.num_iters)
+
+
+# SWBN whitening-matrix step size (arXiv:2106.04413 uses a small fixed
+# rate); the trace-normalized covariance bounds the update spectrum so
+# this default is stable for the tiny g=4 groups.  Env-overridable for
+# the bench's sensitivity sweeps.
+_SWBN_ALPHA_ENV = "DWT_SWBN_ALPHA"
+_SWBN_DEFAULT_ALPHA = 0.3
+
+
+class SWBNWhitener(Whitener):
+    """Stochastic whitening with online statistics (arXiv:2106.04413).
+
+    Maintains the whitening matrix itself as running state: every train
+    step takes one multiplicative update ``w += α (I − w Σ̂ wᵀ) w`` toward
+    the whitening manifold (``Σ̂`` the trace-normalized shrunk batch
+    covariance), and the transform applies the updated ``w`` detached —
+    NO factorization anywhere, forward or backward.  Eval reads the
+    tracked matrix straight from the running state, so the 10-pass stat
+    re-estimation protocol is unnecessary (``needs_stat_collection`` is
+    False): ``--whitener swbn --stat_collection_passes 0`` collapses the
+    OfficeHome eval cadence from ~11 dataset passes to ~1.
+    """
+
+    name = "swbn"
+    needs_stat_collection = False
+    matrix_from_cov = None
+
+    def __init__(self, alpha: Optional[float] = None):
+        # None → resolve the env var lazily at trace time (the registry
+        # singleton is built at import; a constructor-time read would
+        # freeze the default before sweep harnesses can set the env).
+        self.alpha = alpha
+
+    def _alpha(self) -> float:
+        if self.alpha is not None:
+            return self.alpha
+        value = os.environ.get(_SWBN_ALPHA_ENV, "")
+        return float(value) if value else _SWBN_DEFAULT_ALPHA
+
+    def init_stats(self, num_features: int, group_size: int, dtype=jnp.float32):
+        base = init_whitening_stats(num_features, group_size, dtype)
+        num_groups, group_size = _resolve_groups(num_features, group_size)
+        eye = jnp.eye(group_size, dtype=dtype)
+        return SWBNStats(
+            mean=base.mean,
+            cov=base.cov,
+            w=jnp.broadcast_to(eye, (num_groups, group_size, group_size)),
+        )
+
+    @staticmethod
+    def _normalized(cov_shrunk: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """``(Σ/tr_g, sqrt(tr_g))`` with ``tr_g`` the mean eigenvalue —
+        the tracker's domain has O(1) spectrum at every site scale."""
+        g = cov_shrunk.shape[-1]
+        tr_g = (
+            jnp.trace(cov_shrunk, axis1=-2, axis2=-1)[..., None, None] / g
+        )
+        return cov_shrunk / tr_g, jnp.sqrt(tr_g)
+
+    def train_matrix(self, cov, stats, eps):
+        sigma_n, scale = self._normalized(_shrink(cov, eps))
+        # Whole update detached: w is a buffer (the SWBN convention) —
+        # gradients flow through the centered activations only, never
+        # through the factorization (there is none).
+        sigma_n = lax.stop_gradient(sigma_n)
+        scale = lax.stop_gradient(scale)
+        w = stats.w
+        eye = jnp.eye(w.shape[-1], dtype=w.dtype)
+        mm = _small_matmul_fn(w.shape[-1], w.dtype)
+        residual = eye - mm(mm(w, sigma_n), jnp.swapaxes(w, -1, -2))
+        w_next = w + self._alpha() * mm(residual, w)
+        return w_next / scale, w_next
+
+    def update_stats(self, stats, m, cov, momentum, aux):
+        base = super().update_stats(stats, m, cov, momentum, aux)
+        return SWBNStats(mean=base.mean, cov=base.cov, w=aux)
+
+    def eval_matrix(self, stats, eps, dtype=jnp.float32):
+        _, scale = self._normalized(_shrink(stats.cov.astype(dtype), eps))
+        return stats.w.astype(dtype) / scale
+
+
+_WHITENERS = {
+    "cholesky": CholeskyWhitener(),
+    "newton_schulz": NewtonSchulzWhitener(),
+    "swbn": SWBNWhitener(),
+}
+WHITENER_NAMES = tuple(_WHITENERS)
+_CHOLESKY = _WHITENERS["cholesky"]
+
+
+def get_whitener(name: Union[str, Whitener, None]) -> Whitener:
+    """Resolve a ``--whitener`` name (or pass a Whitener through)."""
+    if name is None:
+        return _CHOLESKY
+    if isinstance(name, Whitener):
+        return name
+    try:
+        return _WHITENERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown whitener {name!r}; choose from {WHITENER_NAMES}"
+        ) from None
+
+
 def group_whiten(
     x: jax.Array,
     stats: WhiteningStats,
@@ -269,6 +620,8 @@ def group_whiten(
     momentum: float = 0.1,
     eps: float = 1e-3,
     axis_name: Optional[AxisName] = None,
+    whitener: Union[str, Whitener, None] = None,
+    eval_matrix: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, WhiteningStats]:
     """Whiten channels-last ``x`` per group of channels.
 
@@ -282,10 +635,16 @@ def group_whiten(
       momentum: EMA weight of the NEW observation (``whitening.py:57-59``).
       eps: shrinkage toward identity (``whitening.py:48``).
       axis_name: optional mapped axis for cross-replica moment pmean.
+      whitener: numerics backend (name or instance); None/"cholesky" is
+        the reference path, traced op-for-op as before the refactor.
+      eval_matrix: precomputed eval-mode whitening matrix ``[G, g, g]``
+        (from :func:`build_whiten_cache`) — skips the per-batch
+        factorization from running stats; ignored in train mode.
 
     Returns:
       ``(whitened, new_stats)`` — whitened has the dtype/shape of ``x``.
     """
+    whitener = get_whitener(whitener)
     num_features = x.shape[-1]
     num_groups, group_size = _resolve_groups(num_features, group_size)
 
@@ -298,24 +657,96 @@ def group_whiten(
             m = lax.pmean(m, axis_name)
         xn = xf - m
         cov = group_cov(xn, num_groups, group_size, axis_name)
-        w = whitening_matrix(_shrink(cov, eps))
+        w, aux = whitener.train_matrix(cov, stats, eps)
         # Moments/factorization stay f32; the apply matmul runs in the
         # activation dtype (bf16 nets → bf16 MXU path, f32 accumulation) —
         # the standard mixed-precision norm recipe.
         y = apply_whitening(xn, w, compute_dtype=x.dtype).astype(x.dtype)
-        new_stats = WhiteningStats(
-            mean=(
-                momentum * lax.stop_gradient(m)
-                + (1.0 - momentum) * stats.mean
-            ),
-            cov=(
-                momentum * lax.stop_gradient(cov)
-                + (1.0 - momentum) * stats.cov
-            ),
-        )
-        return y, new_stats
+        return y, whitener.update_stats(stats, m, cov, momentum, aux)
     else:
         xn = xf - stats.mean
-        w = whitening_matrix(_shrink(stats.cov.astype(xf.dtype), eps))
+        if eval_matrix is not None:
+            w = eval_matrix.astype(xf.dtype)
+        else:
+            w = whitener.eval_matrix(stats, eps, xf.dtype)
         y = apply_whitening(xn, w, compute_dtype=x.dtype).astype(x.dtype)
         return y, stats
+
+
+# ------------------------------------------------- eval-matrix precompute
+
+# The Flax collection eval-mode DomainWhiten sites read their precomputed
+# whitening matrix from (variable name "w" at the site's scope path).
+WHITEN_CACHE_COL = "whiten_cache"
+
+
+def _is_whitening_stats(value: Any) -> bool:
+    return hasattr(value, "mean") and hasattr(value, "cov")
+
+
+def build_whiten_cache(
+    batch_stats: Any,
+    whitener: Union[str, Whitener, None] = None,
+    *,
+    eps: float = 1e-3,
+    eval_domain: int = 1,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Precompute every whitening site's eval matrix from frozen stats.
+
+    Eval-mode forwards use running statistics, so the per-site whitening
+    matrices are batch-independent — yet the in-model path re-factorizes
+    at EVERY site for EVERY batch.  This walks ``batch_stats``, takes the
+    ``eval_domain`` branch of each whitening site, and produces a
+    ``{"whiten_cache": tree}`` collection (site scope → ``{"w": [G,g,g]}``)
+    that ``model.apply`` threads to the sites: one factorization per
+    PASS instead of per batch (``train/evalpipe.py``).
+
+    For factorizing backends the sites are batched: every site's shrunk
+    ``[G, g, g]`` covariances with equal ``g`` concatenate into ONE
+    ``[ΣG, g, g]`` call — per-group triangular solves cannot batch across
+    sites, matmul iterations (and the elementwise unrolled Cholesky) can.
+    Returns ``{}`` for models with no whitening sites.
+    """
+    whitener = get_whitener(whitener)
+    sites: List[Tuple[Tuple[str, ...], Any]] = []
+
+    def walk(node: Any, path: Tuple[str, ...]) -> None:
+        for key, value in node.items():
+            if key == "whitening" and _is_whitening_stats(value):
+                sites.append(
+                    (path, jax.tree.map(lambda a: a[eval_domain], value))
+                )
+            elif hasattr(value, "items"):
+                walk(value, path + (key,))
+
+    walk(batch_stats, ())
+    if not sites:
+        return {}
+
+    matrices: Dict[Tuple[str, ...], jax.Array] = {}
+    if whitener.matrix_from_cov is not None:
+        by_g: Dict[int, List[Tuple[Tuple[str, ...], Any]]] = {}
+        for path, branch in sites:
+            by_g.setdefault(branch.cov.shape[-1], []).append((path, branch))
+        for group in by_g.values():
+            stacked = jnp.concatenate(
+                [_shrink(b.cov.astype(dtype), eps) for _, b in group]
+            )
+            ws = whitener.matrix_from_cov(stacked)
+            offset = 0
+            for path, branch in group:
+                n = branch.cov.shape[0]
+                matrices[path] = ws[offset : offset + n]
+                offset += n
+    else:  # online backends (swbn): the matrix IS the running state
+        for path, branch in sites:
+            matrices[path] = whitener.eval_matrix(branch, eps, dtype)
+
+    cache: Dict[str, Any] = {}
+    for path, w in matrices.items():
+        node = cache
+        for key in path:
+            node = node.setdefault(key, {})
+        node["w"] = w
+    return {WHITEN_CACHE_COL: cache}

@@ -99,17 +99,19 @@ def make_sharded_eval_step(
     """shard_map an accumulating eval dispatch over ``mesh``.
 
     ``accum_eval`` is ``steps.make_accum_eval_step(model, axis_name=
-    tuple(mesh.axis_names))``: counters/params/stats replicated, the
-    ``{"x", "y", "mask"}`` chunk sharded on its sample axis (axis 1 —
-    chunk layout ``[k, batch, ...]``), and the chunk's counter deltas
-    ``psum``'d across the mesh inside the step, so the returned counters
-    are the GLOBAL accumulators on every replica — the eval-path twin of
+    tuple(mesh.axis_names))``: counters/params/stats — and the pass's
+    precomputed whitening-matrix cache (replicated like the stats it was
+    factorized from) — replicated, the ``{"x", "y", "mask"}`` chunk
+    sharded on its sample axis (axis 1 — chunk layout ``[k, batch,
+    ...]``), and the chunk's counter deltas ``psum``'d across the mesh
+    inside the step, so the returned counters are the GLOBAL
+    accumulators on every replica — the eval-path twin of
     :func:`make_sharded_train_step`'s counter psum.
     """
     mapped = _shard_map(
         accum_eval,
         mesh=mesh,
-        in_specs=(P(), P(), P(), _chunk_spec(mesh)),
+        in_specs=(P(), P(), P(), P(), _chunk_spec(mesh)),
         out_specs=P(),
     )
     return jax.jit(mapped) if jit else mapped

@@ -25,7 +25,12 @@ had sharding, scan-amortized dispatch, and prefetch.
   compiled dispatch via ``lax.scan``, amortizing the per-dispatch host
   round-trip k-fold (the eval twin of ``--steps_per_dispatch``);
 * **prefetch**: both phases stage batches through
-  ``prefetch_to_device`` with the training loops' staging depth.
+  ``prefetch_to_device`` with the training loops' staging depth;
+* **once-per-pass factorization**: eval-mode whitening matrices are
+  precomputed from the frozen running stats with every site's groups
+  stacked into one batched call (``ops.whitening.build_whiten_cache``)
+  and threaded to the norm sites — instead of every batch re-running
+  Cholesky+inverse at every site.
 
 Parity contract (pinned by ``tests/test_evalpipe.py``): sharded and
 unsharded evals produce IDENTICAL correct/count counters (masked padding
@@ -53,6 +58,7 @@ from dwt_tpu.data.loader import (
     batch_iterator,
     prefetch_to_device,
 )
+from dwt_tpu.ops.whitening import build_whiten_cache, get_whitener
 from dwt_tpu.train.steps import (
     eval_counters,
     make_accum_eval_step,
@@ -121,6 +127,9 @@ class EvalPipeline:
         eval_k: int = 1,
         num_workers: int = 0,
         prefetch_size: int = 2,
+        whitener: str = "cholesky",
+        whiten_eps: float = 1e-3,
+        eval_domain: int = 1,
     ):
         self.test_batch_size = int(test_batch_size)
         self.eval_k = max(1, int(eval_k))
@@ -130,6 +139,16 @@ class EvalPipeline:
         self._procs = jax.process_count()
         self.last_host_fetches = 0  # evidence stream for the bench/tests
         self._warned_unsharded_collect = False
+        # Once-per-PASS whitening-matrix precompute (all sites' groups
+        # stacked into one batched factorization): eval-mode forwards run
+        # off frozen running stats, so re-factorizing at every site for
+        # every batch — what the in-model path does — is pure waste.
+        _whitener = get_whitener(whitener)
+        self._cache_fn = jax.jit(
+            lambda bs: build_whiten_cache(
+                bs, _whitener, eps=whiten_eps, eval_domain=eval_domain
+            )
+        )
 
         model_free = build_model(axis_name=None)  # axis-free twin
         if mesh is not None:
@@ -236,6 +255,9 @@ class EvalPipeline:
             pad_and_mask=True,
         )
         counters = self._place(eval_counters())
+        # The pass's whitening matrices, factorized ONCE from the frozen
+        # running stats (site-stacked) and replicated like the stats.
+        cache = self._place(self._cache_fn(state.batch_stats))
         batches = prefetch_to_device(
             (_stack_eval_chunk(g) for g in _chunk_groups(stream, self.eval_k)),
             size=self.prefetch_size,
@@ -244,7 +266,7 @@ class EvalPipeline:
         try:
             for chunk in batches:
                 counters = self._eval_fn(
-                    counters, state.params, state.batch_stats, chunk
+                    counters, state.params, state.batch_stats, cache, chunk
                 )
         finally:
             batches.close()

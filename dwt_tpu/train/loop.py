@@ -48,6 +48,7 @@ from dwt_tpu.data import (
     random_affine,
 )
 from dwt_tpu.nn import LeNetDWT, ResNetDWT
+from dwt_tpu.ops.whitening import get_whitener
 from dwt_tpu.resilience import (
     AsyncCheckpointer,
     Coordinator,
@@ -108,6 +109,19 @@ def _synthetic_classification_arrays(
         r = (k * rows) // num_classes
         images[i, r : r + band, :, :] += 1.5
     return images, labels.astype(np.int64)
+
+
+def _apply_op_defaults(cfg) -> None:
+    """Process-wide op knobs from the config: the forced apply-matmul
+    lowering (``--apply_lowering``; the auto crossover stays env-tunable
+    via ``DWT_APPLY_CROSSOVER_C``)."""
+    from dwt_tpu.ops.whitening import set_default_apply_lowering
+
+    mode = getattr(cfg, "apply_lowering", None)
+    # "auto" (the flag default) maps to None so the documented precedence
+    # holds: an explicit --apply_lowering wins, else the DWT_APPLY_LOWERING
+    # env var, else the built-in auto heuristic.
+    set_default_apply_lowering(None if mode in (None, "auto") else mode)
 
 
 def _distributed_initialized() -> bool:
@@ -791,7 +805,9 @@ def _make_eval_pipeline(cfg, build_model, mesh, num_domains=None) -> EvalPipelin
     (O(1) host fetches per pass), ``--eval_steps_per_dispatch`` scanned
     dispatch, prefetch at the training staging depth, and — when
     ``--data_parallel`` is on — batches sharded over the same mesh as the
-    train step (composed with the per-process multi-host split)."""
+    train step (composed with the per-process multi-host split).  The
+    pipeline also precomputes each pass's whitening matrices once from
+    the frozen running stats (``--whitener``-aware, site-stacked)."""
     return EvalPipeline(
         build_model,
         cfg.test_batch_size,
@@ -799,6 +815,7 @@ def _make_eval_pipeline(cfg, build_model, mesh, num_domains=None) -> EvalPipelin
         num_domains=num_domains,
         eval_k=max(1, getattr(cfg, "eval_steps_per_dispatch", 1)),
         num_workers=cfg.num_workers,
+        whitener=getattr(cfg, "whitener", "cholesky"),
     )
 
 
@@ -844,6 +861,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     """Train LeNet-DWT; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
     if cfg.group_size == 32:
         # Reference argparse default (usps_mnist.py:348), faithfully kept —
@@ -889,6 +907,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             axis_name=axis_name,
             dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
             use_pallas=cfg.pallas_whiten,
+            whitener=getattr(cfg, "whitener", "cholesky"),
         )
 
     model, wrap, wrap_batch, (make_chunked, wrap_chunk), mesh = _maybe_dp(
@@ -1246,6 +1265,7 @@ def run_officehome(
     """Train ResNet-DWT with MEC; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
 
     source_ds, target_ds, test_ds = _officehome_datasets(cfg)
@@ -1270,6 +1290,7 @@ def run_officehome(
             momentum=cfg.running_momentum,
             axis_name=axis_name,
             use_pallas=cfg.pallas_whiten,
+            whitener=getattr(cfg, "whitener", "cholesky"),
             dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
             remat=cfg.remat,
         )
@@ -1620,6 +1641,25 @@ def run_officehome(
     # (each pass is ~a full dataset forward; with 10 passes + the final
     # eval this phase is ~11 dataset passes, the dominant eval-cadence
     # cost the pipeline exists to cut).
+    if cfg.stat_collection_passes == 0:
+        # The --whitener swbn cadence: the tracked whitening matrices and
+        # BN running stats ARE the eval-time estimates, so the protocol's
+        # ~10 extra dataset passes per eval point buy nothing.  Recorded
+        # so the metrics stream shows the phase was skipped, not lost.
+        logger.log(
+            "stat_collection", int(state.step), skipped=True,
+            whitener=getattr(cfg, "whitener", "cholesky"),
+        )
+    elif not get_whitener(
+        getattr(cfg, "whitener", "cholesky")
+    ).needs_stat_collection:
+        logger.log(
+            "warning", int(state.step),
+            message=f"--whitener {cfg.whitener} runs eval off its online "
+                    f"running estimates; --stat_collection_passes "
+                    f"{cfg.stat_collection_passes} re-estimation passes "
+                    "are unnecessary (pass 0 to skip the phase)",
+        )
     for p in range(cfg.stat_collection_passes):
         # seed/epoch vary the per-item augmentation tokens so each pass
         # draws fresh crops — N identical passes would defeat the

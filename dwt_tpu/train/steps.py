@@ -263,9 +263,15 @@ def eval_counters() -> Metrics:
 
 def make_accum_eval_step(
     model, axis_name: Optional[AxisName] = None
-) -> Callable[[Metrics, Any, Any, Dict[str, jax.Array]], Metrics]:
+) -> Callable[[Metrics, Any, Any, Any, Dict[str, jax.Array]], Metrics]:
     """Accumulating, scanned eval dispatch: ``(counters, params, stats,
-    chunk) -> counters``.
+    cache, chunk) -> counters``.
+
+    ``cache`` is the pass's precomputed whitening-matrix collection
+    (``ops.whitening.build_whiten_cache`` — ``{"whiten_cache": tree}``,
+    or ``{}`` for models with no whitening sites): eval-mode norm sites
+    read their frozen-stats factorization from it instead of re-running
+    it per batch per site.
 
     ``chunk`` stacks k batches — ``{"x": [k, N, ...], "y": [k, N],
     "mask": [k, N] bool}`` — and the scan threads the counter carry
@@ -283,10 +289,14 @@ def make_accum_eval_step(
     ``shard_map`` (``parallel.make_sharded_eval_step``).
     """
 
-    def accum_eval(counters, params, batch_stats, chunk):
+    def accum_eval(counters, params, batch_stats, cache, chunk):
+        variables = {"params": params, "batch_stats": batch_stats}
+        if cache:  # static: {} (no whitening sites) vs the cache tree
+            variables = {**variables, **cache}
+
         def body(c, b):
             logits = model.apply(
-                {"params": params, "batch_stats": batch_stats},
+                variables,
                 b["x"],
                 train=False,
             )

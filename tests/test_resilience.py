@@ -763,11 +763,16 @@ def test_guard_rollback_without_checkpoint_uses_memory_snapshot(tmp_path):
     assert rollbacks and rollbacks[0]["source"] == "memory"
 
 
+@pytest.mark.slow
 def test_guard_ladder_transient_nan_backs_off_and_recovers(tmp_path):
     """Acceptance (ladder, transient): a one-off NaN engages the lr_backoff
     rung — revert to the in-memory good state, scale updates down — and
     after the configured clean checks the scale recovers.  NO rollback is
     spent, NO checkpoint restore happens.
+
+    Slow-marked for the tier-1 budget (PR 6): the ladder's rungs and
+    escalation order stay tier-1-pinned by
+    test_guard_ladder_persistent_nan_escalates_in_order.
 
     The same run also proves --keep_ckpts pruning (one CLI run serves
     both assertions — the tier-1 budget is full): the main dir keeps only
@@ -1343,11 +1348,12 @@ def _assert_graceful_exit(proc, ck, jsonl):
 @pytest.mark.parametrize(
     "dispatch",
     [
-        "1",
-        # The chunked variant costs a second full trainer subprocess;
-        # the chunked preemption path is equally proven by the slow-tier
-        # chaos matrix + the chunked guard-rollback test above, so only
-        # the per-step variant rides in the (full) tier-1 budget.
+        # Both variants cost a full trainer subprocess (~39 s each) and
+        # ride the slow tier since PR 6: the SIGTERM→save→exit-0 contract
+        # stays tier-1-proven by the composed chaos smoke
+        # (test_chaos.py::test_chaos_smoke_composed_faults_exit0_resumable,
+        # which adds notice + io_error on top of the SIGTERM).
+        pytest.param("1", marks=pytest.mark.slow),
         pytest.param("4", marks=pytest.mark.slow),
     ],
 )

@@ -1,0 +1,171 @@
+"""Whitener-backend microbench: factorization, train step, eval pass.
+
+Three measurements per ``--whitener`` backend (PERF.md "Whitener numerics"):
+
+* **factorization** at the ResNet50-DWT site inventory (stem + all of
+  stage 1): the per-site chain (S sequential ``[G, g, g]`` factorizations,
+  one per whitening site — what eval-mode forwards do when matrices are
+  recomputed per site) vs the site-stacked batch (every site's groups
+  concatenated into ONE ``[ΣG, g, g]`` call — what
+  ``ops.whitening.build_whiten_cache`` dispatches);
+* **train step**: jitted LeNet-DWT digits train step (the full fwd+bwd,
+  so backend factorization/update cost is measured in context);
+* **eval pass**: ``EvalPipeline.evaluate`` end-to-end on a synthetic
+  dataset (includes the once-per-pass cache precompute).
+
+On CPU these are plumbing-honest numbers (no MXU); the JSON marks the
+backend.  Usage::
+
+    JAX_PLATFORMS=cpu python tools/whitener_bench.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ResNet50-DWT whitening-site inventory (stem + stage 1, group_size 4):
+# each entry is one site's group count G (channels / 4).
+RESNET50_SITE_GROUPS = (
+    [64 // 4]                                      # stem dn1
+    + [16, 16, 64, 64]                             # layer1_0 (+ downsample)
+    + [16, 16, 64]                                 # layer1_1
+    + [16, 16, 64]                                 # layer1_2
+)
+
+
+def _time(fn, *args, steps=50):
+    import jax
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    run(2)  # warmup (compile)
+    n1 = max(1, steps // 4)
+    n2 = max(steps, n1 + 4)
+    dt1, dt2 = run(n1), run(n2)
+    per = (dt2 - dt1) / (n2 - n1)
+    return per if per > 0 else dt2 / n2
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--eval_size", type=int, default=512,
+                    help="synthetic eval dataset size")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.ops.whitening import WHITENER_NAMES, _shrink, get_whitener
+    from dwt_tpu.train import adam_l2, create_train_state
+    from dwt_tpu.train.evalpipe import EvalPipeline
+    from dwt_tpu.train.steps import make_digits_train_step
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    g = 4
+
+    # Shrunk SPD covariances at every site's group count.
+    site_covs = []
+    for G in RESNET50_SITE_GROUPS:
+        a = rng.normal(size=(G, g, g))
+        site_covs.append(
+            _shrink(jnp.asarray(a @ a.transpose(0, 2, 1) + g * np.eye(g),
+                                jnp.float32), 1e-3)
+        )
+    stacked = jnp.concatenate(site_covs)
+
+    for name in WHITENER_NAMES:
+        wh = get_whitener(name)
+        record = {
+            "whitener": name,
+            "backend": backend,
+            "sites": len(RESNET50_SITE_GROUPS),
+            "stacked_groups": int(stacked.shape[0]),
+        }
+        if wh.matrix_from_cov is not None:
+            # One program containing S sequential site factorizations
+            # (the in-model eval layout) ...
+            chain = jax.jit(
+                lambda covs: [wh.matrix_from_cov(c) for c in covs]
+            )
+            one = jax.jit(wh.matrix_from_cov)
+            # ... and S separate dispatches (the worst-case sequential
+            # chain the stacked batch replaces).
+            dispatches = jax.jit(wh.matrix_from_cov)
+            per_site_ms = _time(chain, site_covs, steps=args.steps) * 1e3
+            dispatch_ms = _time(
+                lambda covs: [dispatches(c) for c in covs],
+                site_covs, steps=args.steps,
+            ) * 1e3
+            stacked_ms = _time(one, stacked, steps=args.steps) * 1e3
+            record["factorize_per_site_chain_ms"] = round(per_site_ms, 4)
+            record["factorize_per_site_dispatch_ms"] = round(dispatch_ms, 4)
+            record["factorize_site_stacked_ms"] = round(stacked_ms, 4)
+            record["stacked_speedup"] = round(
+                per_site_ms / max(stacked_ms, 1e-9), 2
+            )
+            record["stacked_vs_dispatch_speedup"] = round(
+                dispatch_ms / max(stacked_ms, 1e-9), 2
+            )
+        else:
+            record["factorize_per_site_chain_ms"] = None  # no factorization
+
+        # Train step: LeNet digits shapes (the latency-bound tiny-matrix
+        # chain sits inside a real fwd+bwd here).
+        model = LeNetDWT(group_size=4, whitener=name)
+        tx = adam_l2(1e-3)
+        sample = jnp.zeros((2, 32, 28, 28, 1), jnp.float32)
+        state = create_train_state(model, jax.random.key(0), sample, tx)
+        step = jax.jit(make_digits_train_step(model, tx))
+        batch = {
+            "source_x": jnp.asarray(
+                rng.normal(size=(32, 28, 28, 1)), jnp.float32
+            ),
+            "source_y": jnp.asarray(rng.integers(0, 10, size=(32,))),
+            "target_x": jnp.asarray(
+                rng.normal(size=(32, 28, 28, 1)), jnp.float32
+            ),
+        }
+        record["train_step_ms"] = round(
+            _time(lambda b: step(state, b)[1], batch,
+                  steps=max(5, args.steps // 5)) * 1e3, 3
+        )
+
+        # Eval pass end-to-end (incl. once-per-pass cache precompute).
+        from dwt_tpu.data import ArrayDataset
+
+        n = args.eval_size
+        ds = ArrayDataset(
+            rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+            rng.integers(0, 10, size=(n,)).astype(np.int64),
+        )
+        pipe = EvalPipeline(
+            lambda axis_name=None: LeNetDWT(
+                group_size=4, whitener=name, axis_name=axis_name
+            ),
+            100,
+            eval_k=8,
+            whitener=name,
+        )
+        pipe.evaluate(state, ds)  # warm (compile)
+        t0 = time.perf_counter()
+        result = pipe.evaluate(state, ds)
+        record["eval_pass_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        record["eval_imgs_per_s"] = result["eval_imgs_per_s"]
+        print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()
