@@ -110,6 +110,41 @@ def _bench_lenet(steps: int, batch: int):
     return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
 
 
+def _bench_lenet_eval(steps: int, batch: int):
+    """Inference throughput of the digits eval path — the reference
+    ``test()`` loop (``usps_mnist.py:310-327``): target-branch-only
+    forward with running stats.  Satellite of ISSUE-7: the digits forward
+    is a serving workload too, so ``--phase eval`` must cover it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import create_train_state, make_eval_step
+
+    rng = np.random.default_rng(0)
+    b = {
+        "target_x": jnp.asarray(
+            rng.normal(size=(batch, 28, 28, 1)), jnp.float32
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(batch,))),
+    }
+    model = LeNetDWT(group_size=4)
+    sample = jnp.stack([b["target_x"], b["target_x"]])
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    estep = make_eval_step(model)
+
+    def step(s, batch_):
+        m = estep(s.params, s.batch_stats, batch_["target_x"],
+                  batch_["source_y"])
+        return s, {"loss": m["loss_sum"]}
+
+    return _time_steps(jax.jit(step), state, b, steps, imgs_per_step=batch)
+
+
 def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
     """Model/state/batch for the flagship benchmarks.  ``tx`` defaults to
     the reference SGD recipe; the eval bench passes ``optax.identity()``
@@ -498,6 +533,8 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
     if args.model == "lenet":
         # Honor an explicit lenet request (seconds on CPU).
         model_args = ["--model", "lenet"]
+        if args.phase != "train":
+            model_args += ["--phase", args.phase]
         steps = min(args.steps, 10)
     else:
         # The flagship model still gets timed, not a lenet stand-in:
@@ -565,8 +602,6 @@ def main():
         ap.error("--pallas only applies to --model resnet50")
     if args.pallas and args.phase == "eval":
         ap.error("--pallas is a training-path A/B; use --phase train")
-    if args.phase == "eval" and args.model != "resnet50":
-        ap.error("--phase eval is implemented for --model resnet50")
 
     if not args.no_probe:
         # The subprocess jax probe is AUTHORITATIVE; the TCP port poll is
@@ -603,10 +638,15 @@ def main():
 
     if args.model == "lenet":
         batch = args.batch or 32
-        imgs_per_sec, step_time, flops, degraded, tinfo = _bench_lenet(
-            args.steps, batch
-        )
-        metric = "lenet_dwt_train_imgs_per_sec"
+        if args.phase == "eval":
+            imgs_per_sec, step_time, flops, degraded, tinfo = (
+                _bench_lenet_eval(args.steps, batch)
+            )
+        else:
+            imgs_per_sec, step_time, flops, degraded, tinfo = _bench_lenet(
+                args.steps, batch
+            )
+        metric = f"lenet_dwt_{args.phase}_imgs_per_sec"
     else:
         batch = args.batch or 18
         if args.phase == "eval":

@@ -21,6 +21,7 @@ from dwt_tpu.parallel.mesh import (
 )
 from dwt_tpu.parallel.dp import (
     make_sharded_collect_step,
+    make_sharded_serve_forward,
     make_sharded_eval_step,
     make_sharded_scanned_step,
     make_sharded_train_step,
@@ -34,6 +35,7 @@ __all__ = [
     "make_mesh",
     "initialize_distributed",
     "make_sharded_collect_step",
+    "make_sharded_serve_forward",
     "make_sharded_eval_step",
     "make_sharded_scanned_step",
     "make_sharded_train_step",

@@ -143,6 +143,29 @@ def make_sharded_collect_step(
     return jax.jit(mapped) if jit else mapped
 
 
+def make_sharded_serve_forward(
+    forward: Callable,
+    mesh: Mesh,
+    jit: bool = True,
+) -> Callable:
+    """shard_map the serving forward over ``mesh`` (ISSUE-7 replica
+    fan-out): ``forward`` is ``steps.make_serve_forward(model)`` —
+    ``(params, batch_stats, cache, x) -> logits`` — with params, frozen
+    stats, and the whitening cache replicated and the bucket batch's
+    sample axis sharded over every mesh axis.  Eval-mode forwards are
+    per-sample (running stats, no batch moments), so the per-replica body
+    needs NO collectives: logits come back sharded on the same sample
+    axis and the host's single ``device_get`` gathers them.  Bucket sizes
+    must divide the mesh (``serve.engine`` rounds them up)."""
+    mapped = _shard_map(
+        forward,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), _batch_spec(mesh)),
+        out_specs=_batch_spec(mesh),
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
 def shard_batch(batch: Any, mesh: Mesh, chunked: bool = False) -> Any:
     """Place every batch leaf with its leading axis sharded over the mesh
     (``chunked=True``: the SECOND axis — leaf layout ``[k, batch, ...]``).

@@ -131,6 +131,13 @@ class Coordinator:
         self.last_decide_s = 0.0
         self.total_decide_s = 0.0
         self.max_decide_s = 0.0
+        # Bounded window of recent decide latencies: the loops' aggregated
+        # "consensus" records report p50/p99 over it (utils.metrics
+        # percentile helpers — the same definition the serving access log
+        # uses), so tail latency is visible, not just the mean/max.
+        import collections
+
+        self.recent_decide_s = collections.deque(maxlen=512)
 
     @property
     def multi_host(self) -> bool:
@@ -192,6 +199,7 @@ class Coordinator:
         self.last_decide_s = dt
         self.total_decide_s += dt
         self.max_decide_s = max(self.max_decide_s, dt)
+        self.recent_decide_s.append(dt)
         return Decision(
             stop=bool(gathered[:, 0].any()),
             event=int(gathered[:, 1].max()),

@@ -1,0 +1,38 @@
+"""dwt_tpu.serve — inference serving for the deployment forward (ISSUE-7).
+
+The paper's deployment artifact — target-branch eval forward with frozen
+running stats and test-time domain whitening — served as a
+request/response engine: AOT-compiled fixed-bucket forwards
+(:mod:`~dwt_tpu.serve.engine`), deadline micro-batching with bounded
+queues and load shedding (:mod:`~dwt_tpu.serve.batcher`), in-process and
+HTTP front ends with graceful SIGTERM drain
+(:mod:`~dwt_tpu.serve.server`), and per-request JSONL access metrics
+(:mod:`~dwt_tpu.serve.metrics`).  ``tools/serve_bench.py`` drives it
+open-loop (Poisson arrivals) for latency-vs-offered-load curves.
+"""
+
+from dwt_tpu.serve.batcher import (
+    DEFAULT_BUCKETS,
+    Future,
+    MicroBatcher,
+    PlannedBatch,
+    ShedError,
+    bucket_for,
+    plan_dispatch,
+)
+from dwt_tpu.serve.engine import ServeEngine
+from dwt_tpu.serve.metrics import AccessLog
+from dwt_tpu.serve.server import ServeClient
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Future",
+    "MicroBatcher",
+    "PlannedBatch",
+    "ShedError",
+    "bucket_for",
+    "plan_dispatch",
+    "ServeEngine",
+    "AccessLog",
+    "ServeClient",
+]

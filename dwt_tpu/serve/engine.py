@@ -1,0 +1,230 @@
+"""AOT-bucketed inference engine: the deployment forward, compiled once.
+
+The paper's deployment artifact is the target-branch eval forward —
+frozen running stats, domain-specific whitening at test time, no
+augmentation (``dwt_tpu.train.steps.make_serve_forward``).  The engine
+makes that forward servable:
+
+* **load once**: params + ``batch_stats`` restore from a training
+  checkpoint through the SAME newest-valid ranked walk training resume
+  uses (``utils.checkpoint.restore_newest`` — main dir + anchors, both
+  the Orbax and host-shard on-disk formats, digest-verified), with NO
+  optimizer reconstruction (template-free ``restore_tree``);
+* **whiten once**: every site's eval whitening matrix precomputes from
+  the frozen stats in one batched factorization
+  (``evalpipe.make_whiten_cache_fn`` — the eval pipeline's own cache
+  builder), then lives on device for the server's lifetime;
+* **compile once per bucket**: ``jax.jit(fwd).lower(...).compile()``
+  ahead of time for each fixed bucket shape, so the FIRST request of any
+  size pays milliseconds, not an XLA compile;
+* **device-resident**: params/stats/cache are placed on device (replicated
+  over the data mesh under ``--data_parallel``) at load; per-request
+  traffic is just the bucket batch H2D and the logits D2H.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from dwt_tpu.serve.batcher import DEFAULT_BUCKETS, bucket_for, pad_to_bucket
+from dwt_tpu.train.evalpipe import make_whiten_cache_fn
+from dwt_tpu.train.steps import make_serve_forward
+from dwt_tpu.utils import restore_newest
+from dwt_tpu.utils.checkpoint import adapt_tree
+
+log = logging.getLogger(__name__)
+
+
+class ServeEngine:
+    """Compiled bucket forwards over device-resident weights.
+
+    ``input_shape`` is the per-sample shape (e.g. ``(28, 28, 1)`` for
+    digits, ``(224, 224, 3)`` for OfficeHome); ``mesh`` (optional) shards
+    every bucket batch's sample axis over the data mesh — replica
+    fan-out, with bucket sizes rounded UP to mesh multiples so the
+    shards stay equal (pad-and-mask keeps the returned logits exact).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        batch_stats,
+        input_shape: Tuple[int, ...],
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        whitener: Optional[str] = None,
+        whiten_eps: Optional[float] = None,
+        eval_domain: Optional[int] = None,
+        mesh=None,
+        input_dtype=np.float32,
+        step: Optional[int] = None,
+        source: Optional[str] = None,
+    ):
+        self.model = model
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.step = step          # checkpoint step served (None: fresh init)
+        self.source = source      # "checkpoint" | "anchor" | None
+        self._mesh = mesh
+        if mesh is not None:
+            buckets = sorted({
+                -(-int(b) // mesh.size) * mesh.size for b in buckets
+            })
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+
+        if whitener is None:
+            # The cache must be factorized by the SAME backend the model
+            # was built with (swbn caches the tracked matrix itself, the
+            # factorizing backends differ in ulps) — read it off the
+            # model rather than trusting a separately-passed flag.
+            whitener = getattr(model, "whitener", "cholesky")
+        if eval_domain is None:
+            # The cache's stat branch must be the branch the model's norm
+            # sites serve from — read it off the model, don't guess.
+            eval_domain = getattr(model, "eval_domain", 1)
+        if whiten_eps is None:
+            # Same reasoning for the shrinkage eps: a cache factorized
+            # with a different eps than the model's in-site path would
+            # break the bitwise contract with the uncached eval forward.
+            whiten_eps = getattr(model, "whiten_eps", 1e-3)
+        cache = make_whiten_cache_fn(whitener, whiten_eps, eval_domain)(
+            batch_stats
+        )
+        forward = make_serve_forward(model)
+        if mesh is None:
+            self._x_sharding = None
+            place = jax.device_put
+            fwd = forward
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dwt_tpu.parallel import make_sharded_serve_forward
+
+            axes = tuple(mesh.axis_names)
+            self._x_sharding = NamedSharding(mesh, P(axes))
+            place = lambda t: jax.device_put(t, NamedSharding(mesh, P()))
+            fwd = make_sharded_serve_forward(forward, mesh, jit=False)
+        # Device residency: the ONE placement of the run.
+        self.params = place(params)
+        self.batch_stats = place(batch_stats)
+        self.cache = place(cache) if cache else cache
+
+        self._compiled: Dict[int, object] = {}
+        self.compile_s: Dict[int, float] = {}
+        jitted = jax.jit(fwd)
+        for b in self.buckets:
+            spec = jax.ShapeDtypeStruct(
+                (b,) + self.input_shape, self.input_dtype,
+                sharding=self._x_sharding,
+            )
+            t0 = time.perf_counter()
+            self._compiled[b] = jitted.lower(
+                self.params, self.batch_stats, self.cache, spec
+            ).compile()
+            self.compile_s[b] = round(time.perf_counter() - t0, 3)
+        log.info(
+            "serve engine ready: buckets %s compiled in %s s (step=%s)",
+            self.buckets, self.compile_s, step,
+        )
+
+    # -------------------------------------------------------------- loading
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        ckpt_dir: str,
+        model,
+        input_shape: Tuple[int, ...],
+        **kwargs,
+    ) -> "ServeEngine":
+        """Restore the newest valid checkpoint (main dir + anchors, either
+        on-disk format) and build the engine from its params/stats.
+
+        The restore is template-free (no optimizer reconstruction), so
+        the stat structs come back as plain dicts; a one-time
+        ``model.init`` provides the typed structure to graft them onto —
+        which doubles as structural validation that the checkpoint
+        matches the model the server was asked to build."""
+        out = restore_newest(ckpt_dir)  # template-free loose restore
+        if out is None:
+            raise FileNotFoundError(
+                f"no restorable checkpoints under {ckpt_dir} (main or "
+                "anchors) — nothing to serve"
+            )
+        tree, source = out
+        if not isinstance(tree, dict) or "params" not in tree \
+                or "batch_stats" not in tree:
+            raise ValueError(
+                f"checkpoint under {ckpt_dir} restored without params/"
+                "batch_stats — not a TrainState artifact"
+            )
+        import jax.numpy as jnp
+
+        num_domains = getattr(model, "num_domains", 2)
+        sample = jnp.zeros(
+            (num_domains, 1) + tuple(input_shape), jnp.float32
+        )
+        variables = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), sample, train=True)
+        )
+        params = adapt_tree(
+            tree["params"], variables["params"], f"{ckpt_dir} params"
+        )
+        batch_stats = adapt_tree(
+            tree["batch_stats"], variables["batch_stats"],
+            f"{ckpt_dir} batch_stats",
+        )
+        step = tree.get("step")
+        return cls(
+            model, params, batch_stats, input_shape,
+            step=None if step is None else int(np.asarray(step)),
+            source=source,
+            **kwargs,
+        )
+
+    # ------------------------------------------------------------ inference
+
+    def stage(self, x: np.ndarray):
+        """H2D placement of one bucket batch — the ``transfer`` hook for
+        ``prefetch_to_device`` double-buffered staging (server dispatch
+        thread overlaps the next batch's H2D with this one's compute)."""
+        x = np.ascontiguousarray(x, self.input_dtype)
+        if self._x_sharding is None:
+            return jax.device_put(x)
+        return jax.device_put(x, self._x_sharding)
+
+    def forward(self, x_staged, bucket: int):
+        """Compiled forward of one staged bucket batch -> device logits."""
+        fn = self._compiled.get(int(bucket))
+        if fn is None:
+            raise ValueError(
+                f"no compiled forward for bucket {bucket} "
+                f"(compiled: {self.buckets})"
+            )
+        return fn(self.params, self.batch_stats, self.cache, x_staged)
+
+    def infer(self, x: np.ndarray, bucket: Optional[int] = None) -> np.ndarray:
+        """Convenience synchronous path: pad → stage → forward → fetch.
+
+        ``x`` is ``[n, ...sample]`` with ``n`` ≤ the largest bucket;
+        returns the ``[n, classes]`` logits for the REAL rows only.  The
+        server's batched path does these stages on separate threads; this
+        single-call form serves tests and the in-process client's
+        unbatched mode.
+        """
+        x = np.asarray(x, self.input_dtype)
+        n = x.shape[0]
+        if bucket is None:
+            bucket = bucket_for(n, self.buckets)
+        elif n < 1 or n > bucket:
+            raise ValueError(f"got {n} samples for bucket {bucket}")
+        logits = jax.device_get(
+            self.forward(self.stage(pad_to_bucket(x, bucket)), bucket)
+        )
+        return np.asarray(logits)[:n]

@@ -1,0 +1,167 @@
+"""Per-request serving metrics: JSONL access records + latency summary.
+
+Every served (or shed) request produces ONE access record — the serving
+twin of the training loops' metric stream.  Records are machine-parseable
+JSON lines so the same tooling that reads training JSONL reads access
+logs, and the aggregate view (p50/p95/p99 latency, imgs/s, shed rate)
+is computed with the shared nearest-rank percentile helper in
+``dwt_tpu.utils.metrics`` — one percentile definition across training,
+eval, consensus, and serving reports.
+
+Access-record schema (all times milliseconds)::
+
+    {"kind": "access", "status": "ok" | "shed" | "error",
+     "bucket": 8,          # compiled bucket the batch dispatched into
+     "batch_n": 8,         # padded batch size (== bucket)
+     "real_n": 5,          # un-padded samples in the batch
+     "n": 1,               # samples in THIS request
+     "queue_ms": 1.9,      # enqueue -> dispatch (admission + coalescing)
+     "device_ms": 3.1,     # H2D-staged dispatch -> logits fetched
+     "e2e_ms": 5.4,        # enqueue -> response ready
+     "retry_after_ms": 50} # shed responses only
+
+``queue_ms``/``device_ms`` are batch-level quantities stamped onto every
+request that rode the batch; ``e2e_ms`` is per-request.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import IO, Optional
+
+from dwt_tpu.utils.metrics import percentile_summary
+
+log = logging.getLogger(__name__)
+
+# Aggregation window: enough for a long sustained-load run's tail to be
+# measured honestly without unbounded memory on a server that stays up
+# for days.
+_WINDOW = 100_000
+
+
+class AccessLog:
+    """Thread-safe access-record sink: optional JSONL file + aggregates.
+
+    The dispatcher and front-end threads both write here; a lock (not a
+    queue) suffices because records are tiny and the file write is the
+    only I/O.  ``jsonl_path=None`` keeps aggregation only (the in-process
+    client and the bench use the aggregates; the CLI server also writes
+    the file).
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 stream: Optional[IO] = None):
+        self._lock = threading.Lock()
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+        self._stream = stream
+        self._t0 = time.perf_counter()
+        self.served_requests = 0
+        self.served_imgs = 0
+        self.shed_requests = 0
+        self.error_requests = 0
+        self._e2e_ms = collections.deque(maxlen=_WINDOW)
+        self._queue_ms = collections.deque(maxlen=_WINDOW)
+        self._device_ms = collections.deque(maxlen=_WINDOW)
+        self._write_failed = False  # warn once, not per record
+
+    def record(self, status: str, n: int, **fields) -> None:
+        rec = {"kind": "access", "status": status, "n": int(n), **{
+            k: (round(float(v), 3) if isinstance(v, float) else v)
+            for k, v in fields.items()
+        }}
+        with self._lock:
+            if status == "ok":
+                self.served_requests += 1
+                self.served_imgs += int(n)
+                if "e2e_ms" in fields:
+                    self._e2e_ms.append(float(fields["e2e_ms"]))
+                if "queue_ms" in fields:
+                    self._queue_ms.append(float(fields["queue_ms"]))
+                if "device_ms" in fields:
+                    self._device_ms.append(float(fields["device_ms"]))
+            elif status == "shed":
+                self.shed_requests += 1
+            else:
+                self.error_requests += 1
+            # Logging is availability-decoupled: record() runs on the
+            # dispatcher thread, and a full disk must degrade to lost
+            # access records — not to a dead dispatcher that sheds all
+            # traffic while inference itself is healthy.
+            line = json.dumps(rec) + "\n"
+            for sink in (self._file, self._stream):
+                if sink is not None:
+                    try:
+                        sink.write(line)
+                    except (OSError, ValueError) as e:
+                        if not self._write_failed:
+                            self._write_failed = True
+                            log.warning(
+                                "access-log write failed (%s); further "
+                                "records may be lost", e,
+                            )
+
+    def summary(self) -> dict:
+        """Aggregate view over the run (latencies over the bounded
+        window): the /stats response body and the drain-time footer."""
+        # Snapshot under the lock, sort/aggregate OUTSIDE it: summary()
+        # is a /stats poll, and the dispatcher's record() must not queue
+        # behind O(window log window) percentile math on the hot path.
+        with self._lock:
+            seconds = time.perf_counter() - self._t0
+            out = {
+                "kind": "serve_summary",
+                "served_requests": self.served_requests,
+                "served_imgs": self.served_imgs,
+                "shed_requests": self.shed_requests,
+                "error_requests": self.error_requests,
+                "seconds": round(seconds, 3),
+                "imgs_per_s": round(
+                    self.served_imgs / max(seconds, 1e-9), 1
+                ),
+            }
+            windows = [
+                ("e2e_ms", list(self._e2e_ms)),
+                ("queue_ms", list(self._queue_ms)),
+                ("device_ms", list(self._device_ms)),
+            ]
+        for name, window in windows:
+            out.update(percentile_summary(
+                window, (50.0, 95.0, 99.0), prefix=f"{name}_p"
+            ))
+        return out
+
+    def windows(self) -> dict:
+        """Consistent snapshot of the latency windows plus the lifetime
+        served-request count.  The serve bench takes one snapshot before
+        and one after each offered-load run and keeps the last
+        ``served_after - served_before`` samples of each window — correct
+        even after the bounded deques wrap (an index diff would not be),
+        so every sweep point reports only its OWN requests' tail."""
+        with self._lock:
+            return {
+                "served_requests": self.served_requests,
+                "e2e_ms": list(self._e2e_ms),
+                "queue_ms": list(self._queue_ms),
+                "device_ms": list(self._device_ms),
+            }
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                except OSError as e:
+                    log.warning("access-log flush failed: %s", e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError as e:
+                    log.warning("access-log close failed: %s", e)
+                self._file = None

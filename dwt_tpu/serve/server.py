@@ -1,0 +1,512 @@
+"""Serving front ends: dispatcher thread, in-process client, HTTP JSONL.
+
+Wiring (the whole data path)::
+
+    submit()  ->  MicroBatcher (admission, coalescing, shedding)
+                      |  PlannedBatch stream
+                      v
+              prefetch_to_device (double-buffered H2D staging:
+                      |            batch k+1 stages while k computes)
+                      v
+              ServeEngine.forward (AOT bucket executable)
+                      |  device logits -> host fetch
+                      v
+              per-request futures resolved + AccessLog records
+
+:class:`ServeClient` is the in-process form (tests, ``tools/serve_bench``);
+``main`` wraps it in a stdlib ``http.server`` front end (one JSON line per
+response — the JSONL convention every tool in this repo reads) with
+graceful SIGTERM drain reusing the resilience layer's flag-only handler
+pattern: in-flight requests complete, queued requests dispatch, new
+arrivals shed with ``Retry-After``, exit code 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from dwt_tpu.data.loader import prefetch_to_device
+from dwt_tpu.serve.batcher import (
+    DEFAULT_BUCKETS,
+    Future,
+    MicroBatcher,
+    PlannedBatch,
+    ShedError,
+    resolve_future,
+)
+from dwt_tpu.serve.engine import ServeEngine
+from dwt_tpu.serve.metrics import AccessLog
+
+log = logging.getLogger(__name__)
+
+
+class _Dispatcher(threading.Thread):
+    """Drains the batcher through the engine; resolves request futures.
+
+    One thread owns all device work (the AOT executables are cheap to
+    call but not re-entrant-free across threads by contract here), with
+    H2D staging overlapped by ``prefetch_to_device``'s producer thread.
+    """
+
+    def __init__(self, engine: ServeEngine, batcher: MicroBatcher,
+                 access_log: AccessLog, staging_depth: int = 2):
+        super().__init__(name="dwt-serve-dispatch", daemon=True)
+        self.engine = engine
+        self.batcher = batcher
+        self.access_log = access_log
+        self.staging_depth = staging_depth
+        self.error: Optional[BaseException] = None
+        # Batches pulled from the batcher but not yet resolved: a batch
+        # inside the staging pipeline is in NEITHER the batcher's queue
+        # nor the compute loop when staging raises — its futures would
+        # be lost without this ledger.  deque append/popleft are atomic;
+        # prefetch preserves order, so popleft always matches.
+        import collections
+
+        self._inflight = collections.deque()
+
+    def _planned(self):
+        while True:
+            pb = self.batcher.next_batch()
+            if pb is None:
+                return
+            self._inflight.append(pb)
+            yield pb
+
+    def run(self) -> None:
+        engine = self.engine
+        # The batcher's clock stamped enqueue_t/dispatch_t; e2e must be
+        # read off the SAME clock at resolution time so it covers the
+        # whole enqueue → response-ready span — including the wait in
+        # the staging buffer, which queue_ms/device_ms both exclude.
+        clock = self.batcher.clock
+
+        def stage(pb: PlannedBatch):
+            return pb, engine.stage(pb.x)
+
+        staged = prefetch_to_device(
+            self._planned(), size=self.staging_depth, transfer=stage
+        )
+        try:
+            for pb, x_dev in staged:
+                t_dev0 = time.perf_counter()
+                try:
+                    logits = np.asarray(
+                        jax.device_get(engine.forward(x_dev, pb.bucket))
+                    )
+                except Exception as e:  # resolve, don't strand waiters
+                    for req in pb.requests:
+                        self.access_log.record(
+                            "error", req.n, bucket=pb.bucket,
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                        resolve_future(req.future, exc=e)
+                    self._inflight.popleft()
+                    continue
+                t_done = time.perf_counter()
+                device_ms = (t_done - t_dev0) * 1e3
+                self.batcher.note_served(pb.real_n, t_done - t_dev0)
+                now = clock()
+                for req, (lo, hi) in zip(pb.requests, pb.slices):
+                    # Record BEFORE resolving: a caller woken by the
+                    # future must find this request's record already in
+                    # the log (the bench windows on exactly that).
+                    self.access_log.record(
+                        "ok", req.n,
+                        bucket=pb.bucket, batch_n=pb.bucket,
+                        real_n=pb.real_n,
+                        queue_ms=(pb.dispatch_t - req.enqueue_t) * 1e3,
+                        device_ms=device_ms,
+                        e2e_ms=(now - req.enqueue_t) * 1e3,
+                    )
+                    resolve_future(req.future, result=logits[lo:hi])
+                self._inflight.popleft()
+        except BaseException as e:
+            # A staging/placement failure surfaces HERE (re-raised out of
+            # prefetch_to_device) — the dispatcher is dead.  Dying
+            # silently would strand every queued future until its client
+            # timeout while /healthz kept answering ok: close admission,
+            # fail everything pending, and leave the error for health
+            # reporting.
+            self.error = e
+            log.exception(
+                "serving dispatcher died; shedding all pending requests"
+            )
+            # Order matters: close admission (unblocks a producer parked
+            # in next_batch), then JOIN the producer via staged.close()
+            # — only a dead producer can no longer append to _inflight —
+            # and only then drain the ledger and the leftover queue.  A
+            # drain racing a live producer would strand whatever it
+            # appended after the drain loop passed.
+            self.batcher.close()
+            staged.close()
+
+            def _fail(pb):
+                for req in pb.requests:
+                    self.access_log.record(
+                        "error", req.n,
+                        error=f"dispatcher dead: {type(e).__name__}: {e}",
+                    )
+                    resolve_future(req.future, exc=e)
+
+            while self._inflight:  # pulled into staging, never resolved
+                _fail(self._inflight.popleft())
+            while True:  # still queued in the batcher
+                pb = self.batcher.next_batch(timeout=0)
+                if pb is None:
+                    break
+                _fail(pb)
+        finally:
+            staged.close()
+
+
+class ServeClient:
+    """In-process serving client: the test/bench seam.
+
+    Owns the batcher + dispatcher around an engine.  ``submit`` returns a
+    :class:`Future` of the request's ``[n, classes]`` logits; ``infer``
+    is the blocking form.  ``close(drain=True)`` is the SIGTERM path's
+    core: stop admissions, flush the queue, join the dispatcher.
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        *,
+        max_batch_delay_ms: float = 5.0,
+        max_queue_items: int = 1024,
+        access_log: Optional[AccessLog] = None,
+        staging_depth: int = 2,
+    ):
+        self.engine = engine
+        self.access_log = access_log or AccessLog()
+        self.batcher = MicroBatcher(
+            buckets=engine.buckets,
+            max_batch_delay_ms=max_batch_delay_ms,
+            max_queue_items=max_queue_items,
+            # Admission-time shape enforcement: a mismatched request is a
+            # 400 to ITS client, never a concatenate error inside the
+            # dispatcher that would take down the whole batch.
+            sample_shape=engine.input_shape,
+        )
+        self._dispatcher = _Dispatcher(
+            engine, self.batcher, self.access_log, staging_depth
+        )
+        self._dispatcher.start()
+
+    @property
+    def dispatcher_alive(self) -> bool:
+        return self._dispatcher.is_alive()
+
+    @property
+    def dispatcher_error(self) -> Optional[BaseException]:
+        return self._dispatcher.error
+
+    def submit(self, x: np.ndarray) -> Future:
+        try:
+            return self.batcher.submit(x)
+        except ShedError as e:
+            self.access_log.record(
+                "shed", int(np.asarray(x).shape[0]),
+                retry_after_ms=e.retry_after_ms, queued=e.queued,
+            )
+            raise
+
+    def infer(self, x: np.ndarray, timeout: Optional[float] = 60.0):
+        return self.submit(x).result(timeout)
+
+    def close(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Graceful stop: (optionally) let the queue drain, then join the
+        dispatcher.  With ``drain=False`` queued requests are failed."""
+        if not drain:
+            self.batcher.fail_pending(RuntimeError("server shutting down"))
+        self.batcher.close()
+        self._dispatcher.join(timeout)
+        if self._dispatcher.is_alive():
+            raise RuntimeError("serving dispatcher did not drain in time")
+
+
+# ------------------------------------------------------------- HTTP front
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by _make_handler:
+    client: ServeClient = None  # type: ignore[assignment]
+    draining = None             # threading.Event
+    # Socket read timeout: handler threads are non-daemon and joined at
+    # drain (no torn responses), so a client stalled mid-upload must not
+    # be able to hold exit hostage.  Above the 60 s future timeout.
+    timeout = 70.0
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict, headers=()) -> None:
+        body = (json.dumps(payload) + "\n").encode()  # one JSON line
+        self.send_response(code)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            # A dead dispatcher is NOT healthy, whatever the listener
+            # thinks — orchestration must see it and recycle the process.
+            alive = self.client.dispatcher_alive
+            err = self.client.dispatcher_error
+            self._reply(200 if alive else 503, {
+                "ok": alive,
+                "draining": bool(self.draining.is_set()),
+                "buckets": list(self.client.engine.buckets),
+                "queued_items": self.client.batcher.queued_items,
+                "step": self.client.engine.step,
+                **({"dispatcher_error": f"{type(err).__name__}: {err}"}
+                   if err is not None else {}),
+            })
+        elif self.path == "/stats":
+            self._reply(200, self.client.access_log.summary())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path not in ("/infer", "/v1/infer"):
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            x = np.asarray(payload["inputs"], np.float32)
+            if x.ndim == len(self.client.engine.input_shape):
+                x = x[None]  # single sample -> batch of one
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        if self.draining.is_set():
+            # Drain half-close: the batcher below would shed too, but
+            # answering here keeps the contract crisp (and cheap).
+            self._reply(503, {
+                "error": "draining", "retry_after_ms": 1000,
+            }, headers=[("Retry-After", "1")])
+            return
+        try:
+            future = self.client.submit(x)
+            logits = future.result(timeout=60.0)
+        except ShedError as e:
+            self._reply(429, {
+                "error": "overloaded",
+                "retry_after_ms": e.retry_after_ms,
+            }, headers=[
+                ("Retry-After", str(max(1, e.retry_after_ms // 1000))),
+            ])
+            return
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {
+            "logits": np.asarray(logits).tolist(),
+            "pred": np.argmax(logits, axis=-1).tolist(),
+            "step": self.client.engine.step,
+        })
+
+
+def _make_handler(client: ServeClient, draining: threading.Event):
+    return type("Handler", (_Handler,), {
+        "client": client, "draining": draining,
+    })
+
+
+def build_model(args):
+    """Model factory mirroring the training CLIs' constructors — the
+    serving process must build the SAME architecture the checkpoint was
+    trained with (params are validated structurally at first forward)."""
+    import jax.numpy as jnp
+
+    if args.model == "lenet":
+        from dwt_tpu.nn import LeNetDWT
+
+        model = LeNetDWT(
+            group_size=args.group_size,
+            whitener=args.whitener,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
+        input_shape = (28, 28, 1)
+    else:
+        from dwt_tpu.nn import ResNetDWT
+
+        ctors = {
+            "resnet50": ResNetDWT.resnet50,
+            "resnet101": ResNetDWT.resnet101,
+            "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
+        }
+        model = ctors[args.model](
+            num_classes=args.num_classes,
+            group_size=args.group_size,
+            whitener=args.whitener,
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
+        input_shape = (args.image_size, args.image_size, 3)
+    return model, input_shape
+
+
+def _fresh_init_state(model, input_shape, seed: int = 0):
+    """--init_random: params/stats from a fresh init (load-testing a
+    serving stack without a trained artifact)."""
+    import jax.numpy as jnp
+
+    num_domains = getattr(model, "num_domains", 2)
+    sample = jnp.zeros((num_domains, 2) + tuple(input_shape), jnp.float32)
+    variables = model.init(jax.random.key(seed), sample, train=True)
+    return variables["params"], variables["batch_stats"]
+
+
+def build_engine(args) -> ServeEngine:
+    model, input_shape = build_model(args)
+    mesh = None
+    if args.data_parallel:
+        from dwt_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.ckpt_dir:
+        return ServeEngine.from_checkpoint(
+            args.ckpt_dir, model, input_shape,
+            buckets=buckets, whitener=args.whitener, mesh=mesh,
+        )
+    if not args.init_random:
+        raise SystemExit(
+            "dwt-serve: pass --ckpt_dir (a training checkpoint directory) "
+            "or --init_random for a fresh-init smoke server"
+        )
+    params, stats = _fresh_init_state(model, input_shape, args.seed)
+    return ServeEngine(
+        model, params, stats, input_shape,
+        buckets=buckets, whitener=args.whitener, mesh=mesh,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="dwt-serve: AOT-bucketed micro-batching inference "
+        "server for the DWT deployment forward"
+    )
+    p.add_argument("--ckpt_dir", default=None,
+                   help="training checkpoint directory (newest valid step "
+                        "restores; anchors ranked too; both on-disk formats)")
+    p.add_argument("--init_random", action="store_true",
+                   help="serve a freshly initialized model (no checkpoint; "
+                        "load testing / smoke)")
+    p.add_argument("--model",
+                   choices=["lenet", "tiny", "resnet50", "resnet101"],
+                   default="lenet")
+    p.add_argument("--group_size", type=int, default=4)
+    p.add_argument("--num_classes", type=int, default=65,
+                   help="resnet head size (lenet is always 10)")
+    p.add_argument("--image_size", type=int, default=224,
+                   help="resnet input resolution")
+    p.add_argument("--whitener",
+                   choices=["cholesky", "newton_schulz", "swbn"],
+                   default="cholesky")
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="comma-separated AOT batch buckets (ascending)")
+    p.add_argument("--max_batch_delay_ms", type=float, default=5.0,
+                   help="deadline: a queued request waits at most this "
+                        "long for its bucket to fill")
+    p.add_argument("--max_queue", type=int, default=1024,
+                   help="admission high-water mark in SAMPLES; beyond it "
+                        "requests shed with 429 + Retry-After")
+    p.add_argument("--data_parallel", action="store_true",
+                   help="shard every bucket over all local devices (data "
+                        "mesh replica fan-out)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8978)
+    p.add_argument("--access_log", default=None,
+                   help="JSONL access-record file (schema: serve/metrics.py)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    engine = build_engine(args)
+    access_log = AccessLog(args.access_log)
+    client = ServeClient(
+        engine,
+        max_batch_delay_ms=args.max_batch_delay_ms,
+        max_queue_items=args.max_queue,
+        access_log=access_log,
+    )
+
+    # Flag-only signal handling (the resilience PreemptionHandler
+    # pattern): the handler must not touch locks/buffered I/O; the main
+    # thread notices the flag and runs the drain.
+    draining = threading.Event()
+
+    def _handle(signum, frame):
+        draining.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _handle)
+
+    # Handler threads must be NON-daemon: the drain path resolves a
+    # queued future, waking its handler to serialize + write the
+    # response; with daemon threads the interpreter exit at the end of
+    # main() could kill that handler mid-write — a torn response on the
+    # exact path that promises none.  Non-daemon threads are tracked by
+    # ThreadingMixIn (block_on_close default) and joined by
+    # server_close() below.
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = False
+
+    httpd = _Server(
+        (args.host, args.port), _make_handler(client, draining)
+    )
+    http_thread = threading.Thread(
+        target=httpd.serve_forever, name="dwt-serve-http", daemon=True
+    )
+    http_thread.start()
+    # One parsable readiness line (the bench and tests wait for it).
+    print(json.dumps({
+        "kind": "serve_ready",
+        "host": args.host, "port": httpd.server_address[1],
+        "buckets": list(engine.buckets),
+        "step": engine.step, "source": engine.source,
+        "compile_s": engine.compile_s,
+    }), flush=True)
+
+    draining.wait()  # the serving steady state lives on other threads
+    log.info("drain: SIGTERM/SIGINT received; completing in-flight work")
+    # Half-close order: (1) stop admitting (new requests shed with
+    # retry-after — the handler's `draining` check plus the batcher's
+    # drain mode), (2) flush the queue through the engine, (3) stop the
+    # HTTP listener, (4) summary + exit 0.  In-flight HTTP handlers
+    # holding futures resolve during (2) — no torn responses.
+    client.batcher.drain()
+    client.close(drain=True)
+    httpd.shutdown()
+    http_thread.join(timeout=10)
+    httpd.server_close()  # joins handler threads still writing replies
+    summary = access_log.summary()
+    print(json.dumps(summary), flush=True)
+    access_log.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

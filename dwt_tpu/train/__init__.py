@@ -12,12 +12,14 @@ from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.optim import adam_l2, multistep_schedule, sgd_two_group
 from dwt_tpu.train.steps import (
     eval_counters,
+    eval_variables,
     make_accum_eval_step,
     make_digits_train_step,
     make_eval_step,
     make_officehome_train_step,
     make_scanned_collect,
     make_scanned_step,
+    make_serve_forward,
     make_stat_collection_step,
     stack_batches,
 )
@@ -31,12 +33,14 @@ __all__ = [
     "sgd_two_group",
     "EvalPipeline",
     "eval_counters",
+    "eval_variables",
     "make_accum_eval_step",
     "make_digits_train_step",
     "make_eval_step",
     "make_officehome_train_step",
     "make_scanned_collect",
     "make_scanned_step",
+    "make_serve_forward",
     "make_stat_collection_step",
     "stack_batches",
 ]

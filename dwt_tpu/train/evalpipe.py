@@ -65,6 +65,7 @@ from dwt_tpu.train.steps import (
     make_scanned_collect,
     make_stat_collection_step,
 )
+from dwt_tpu.utils.metrics import percentile_summary
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +78,25 @@ def _fetch(tree):
     host-fetch contract by monkeypatching a single seam.
     """
     return jax.device_get(tree)
+
+
+def make_whiten_cache_fn(
+    whitener: str = "cholesky",
+    whiten_eps: float = 1e-3,
+    eval_domain: int = 1,
+):
+    """Jitted once-per-pass whitening-matrix precompute:
+    ``batch_stats -> {"whiten_cache": tree}`` (or ``{}``) with every
+    site's groups stacked into one batched factorization.  Shared by
+    :class:`EvalPipeline` and the serving engine (``dwt_tpu.serve``), so
+    both eval and deployment forwards read matrices produced by the SAME
+    compiled program from the same frozen stats."""
+    _whitener = get_whitener(whitener)
+    return jax.jit(
+        lambda bs: build_whiten_cache(
+            bs, _whitener, eps=whiten_eps, eval_domain=eval_domain
+        )
+    )
 
 
 def _chunk_groups(batches, k: int):
@@ -96,7 +116,7 @@ def _chunk_groups(batches, k: int):
         yield buf
 
 
-def _stack_eval_chunk(group):
+def stack_eval_chunk(group):
     """``[(x, y, mask), ...] -> {"x": [k, N, ...], "y": [k, N],
     "mask": [k, N]}`` — the accumulating eval step's input layout."""
     xs, ys, ms = zip(*group)
@@ -143,11 +163,8 @@ class EvalPipeline:
         # stacked into one batched factorization): eval-mode forwards run
         # off frozen running stats, so re-factorizing at every site for
         # every batch — what the in-model path does — is pure waste.
-        _whitener = get_whitener(whitener)
-        self._cache_fn = jax.jit(
-            lambda bs: build_whiten_cache(
-                bs, _whitener, eps=whiten_eps, eval_domain=eval_domain
-            )
+        self._cache_fn = make_whiten_cache_fn(
+            whitener, whiten_eps, eval_domain
         )
 
         model_free = build_model(axis_name=None)  # axis-free twin
@@ -259,15 +276,27 @@ class EvalPipeline:
         # running stats (site-stacked) and replicated like the stats.
         cache = self._place(self._cache_fn(state.batch_stats))
         batches = prefetch_to_device(
-            (_stack_eval_chunk(g) for g in _chunk_groups(stream, self.eval_k)),
+            (stack_eval_chunk(g) for g in _chunk_groups(stream, self.eval_k)),
             size=self.prefetch_size,
             transfer=self._transfer,
         )
+        dispatch_intervals = []  # host-side gap between chunk dispatches
+        first = True
         try:
+            t_prev = time.perf_counter()
             for chunk in batches:
                 counters = self._eval_fn(
                     counters, state.params, state.batch_stats, cache, chunk
                 )
+                t_now = time.perf_counter()
+                if first:
+                    # The first dispatch of a run pays the jit
+                    # trace+compile (seconds); booking it as an interval
+                    # would make dispatch_ms_p99 a false stall alarm.
+                    first = False
+                else:
+                    dispatch_intervals.append(t_now - t_prev)
+                t_prev = t_now
         finally:
             batches.close()
         vals = _fetch(counters)  # the pass's ONE device→host sync
@@ -295,6 +324,15 @@ class EvalPipeline:
             "count": count,
             "eval_s": round(seconds, 3),
             "eval_imgs_per_s": round(count / max(seconds, 1e-9), 1),
+            # Host-side interval between consecutive chunk dispatches
+            # (staging wait + dispatch, NOT device latency — dispatch is
+            # async): a fat p99 here means the prefetch pipeline stalled.
+            # Shared percentile definition with the serving access log
+            # and consensus records (utils.metrics).
+            **percentile_summary(
+                [v * 1e3 for v in dispatch_intervals], (50.0, 99.0),
+                prefix="dispatch_ms_p",
+            ),
         }
 
     # -------------------------------------------------- stat collection

@@ -83,11 +83,16 @@ from dwt_tpu.train.steps import (
 )
 from dwt_tpu.utils import (
     MetricLogger,
+    anchor_dir,
     is_valid_checkpoint,
+    percentile_summary,
+    ranked_checkpoints,
+    restore_newest,
     restore_state,
     save_state,
     valid_steps,
 )
+from dwt_tpu.utils.checkpoint import ANCHOR_SUBDIR  # noqa: F401  (re-export)
 
 log = logging.getLogger(__name__)
 
@@ -460,6 +465,12 @@ class _StepBoundary:
             last_s=round(c.last_decide_s, 6),
             mean_s=round(c.total_decide_s / c.decides, 6),
             max_s=round(c.max_decide_s, 6),
+            # Tail latency over the recent-decide window, via the shared
+            # percentile helper — the same p50/p99 definition the serving
+            # access log and eval records report.
+            **percentile_summary(
+                c.recent_decide_s, (50.0, 99.0), prefix="p", round_to=6
+            ),
         )
 
     def __call__(self, state, metrics, n_steps: int, gstep: int):
@@ -551,15 +562,11 @@ class _StepBoundary:
 # diverged would be the one guaranteed-useless retry).
 _ROLLBACK_SEED_STRIDE = 7919
 
-# Anchor checkpoints (--anchor_every) live in a subdirectory of ckpt_dir:
-# nothing ever prunes or overwrites there, so under repeated divergence the
-# rollback distance is bounded by the anchor cadence even if every
-# checkpoint in the main directory has been torn, poisoned, or pruned.
-ANCHOR_SUBDIR = "anchors"
-
-
-def _anchor_dir(ckpt_dir: str) -> str:
-    return os.path.join(ckpt_dir, ANCHOR_SUBDIR)
+# Anchor layout, ranked walk, and newest-valid restore live in
+# utils.checkpoint since ISSUE-7 (the serving engine loads checkpoints
+# through the SAME walk); the loop-local names below are kept as aliases
+# for this module's many call sites.
+_anchor_dir = anchor_dir
 
 
 class _CkptPipeline:
@@ -676,49 +683,8 @@ def _keep_kwargs(cfg) -> dict:
     return {"keep": keep} if keep > 0 else {}
 
 
-def _ranked_checkpoints(ckpt_dir: str):
-    """Every valid checkpoint across the main dir and its anchors as
-    ``(step, is_main, source, dir)``, newest step first (ties — a step
-    saved to both dirs — prefer the main dir)."""
-    ranked = []
-    for src, d in (("checkpoint", ckpt_dir), ("anchor", _anchor_dir(ckpt_dir))):
-        for s in valid_steps(d):
-            ranked.append((s, src == "checkpoint", src, d))
-    ranked.sort(reverse=True)
-    return ranked
-
-
-def _restore_newest(ckpt_dir: str, template, ranked=None):
-    """Restore the newest step that validates AND restores, ranked by
-    STEP across the main dir and the anchors dir; ``(state, source)`` or
-    None.  Ranking whole directories instead would let a size-valid but
-    digest-corrupt newest main checkpoint drag the restore to an
-    arbitrarily old main-dir step while a newer valid anchor sits ignored
-    — exactly the rollback-distance bound anchors exist to provide.  Both
-    plain resume and guard rollback go through this, so the two recovery
-    paths agree on what "newest" means.  ``ranked`` reuses a
-    ``_ranked_checkpoints`` walk the caller already paid for (validation
-    stats every manifest-listed file — costly on networked storage).
-    """
-    if ranked is None:
-        ranked = _ranked_checkpoints(ckpt_dir)
-    errors = []
-    for s, _, src, d in ranked:
-        try:
-            return restore_state(d, template, step=s), src
-        except (OSError, ValueError) as e:
-            errors.append(f"{src} step {s}: {e}")
-            continue
-    if errors:
-        # Every candidate failed — say WHY before the caller dies with a
-        # bare "no restorable checkpoints": an opt-state STRUCTURE
-        # mismatch (e.g. artifacts written by an older revision) needs a
-        # very different operator response than torn bytes.
-        log.warning(
-            "no checkpoint under %s restored; per-candidate errors: %s",
-            ckpt_dir, " | ".join(errors[:4]),
-        )
-    return None
+_ranked_checkpoints = ranked_checkpoints
+_restore_newest = restore_newest
 
 
 def _rollback_state(

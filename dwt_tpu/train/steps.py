@@ -261,6 +261,35 @@ def eval_counters() -> Metrics:
     }
 
 
+def eval_variables(params: Any, batch_stats: Any, cache: Any) -> Dict:
+    """The eval-mode ``model.apply`` variables dict: params + frozen
+    running stats + (when the model has whitening sites) the pass's
+    precomputed ``whiten_cache`` collection.  Shared by the accumulating
+    eval step and the serving engine's forward so both paths assemble
+    IDENTICAL programs — the bitwise-parity contract between served
+    logits and eval counters rests on this being one code path."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    if cache:  # static: {} (no whitening sites) vs the cache tree
+        variables = {**variables, **cache}
+    return variables
+
+
+def make_serve_forward(
+    model,
+) -> Callable[[Any, Any, Any, jax.Array], jax.Array]:
+    """``(params, batch_stats, cache, x) -> logits`` — the deployment
+    forward: target-branch eval mode, frozen running stats, whitening
+    matrices read from the precomputed cache.  This is the exact forward
+    the accumulating eval step reduces into counters; the serving engine
+    AOT-compiles it per bucket shape (``dwt_tpu.serve.engine``)."""
+
+    def forward(params, batch_stats, cache, x):
+        return model.apply(eval_variables(params, batch_stats, cache), x,
+                           train=False)
+
+    return forward
+
+
 def make_accum_eval_step(
     model, axis_name: Optional[AxisName] = None
 ) -> Callable[[Metrics, Any, Any, Any, Dict[str, jax.Array]], Metrics]:
@@ -290,9 +319,7 @@ def make_accum_eval_step(
     """
 
     def accum_eval(counters, params, batch_stats, cache, chunk):
-        variables = {"params": params, "batch_stats": batch_stats}
-        if cache:  # static: {} (no whitening sites) vs the cache tree
-            variables = {**variables, **cache}
+        variables = eval_variables(params, batch_stats, cache)
 
         def body(c, b):
             logits = model.apply(

@@ -1,10 +1,18 @@
 """dwt_tpu.utils — metrics logging, checkpoints, repro verdicts."""
 
-from dwt_tpu.utils.metrics import MetricLogger
+from dwt_tpu.utils.metrics import (
+    MetricLogger,
+    percentile,
+    percentile_summary,
+)
 from dwt_tpu.utils.checkpoint import (
+    anchor_dir,
     is_valid_checkpoint,
     latest_step,
+    ranked_checkpoints,
+    restore_newest,
     restore_state,
+    restore_tree,
     save_state,
     valid_steps,
 )
@@ -17,9 +25,15 @@ from dwt_tpu.utils.repro import (
 
 __all__ = [
     "MetricLogger",
+    "percentile",
+    "percentile_summary",
+    "anchor_dir",
     "is_valid_checkpoint",
     "latest_step",
+    "ranked_checkpoints",
+    "restore_newest",
     "restore_state",
+    "restore_tree",
     "save_state",
     "valid_steps",
     "accuracy_verdict",

@@ -52,9 +52,10 @@ import hashlib
 import json
 import logging
 import os
+import re
 import shutil
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -667,3 +668,208 @@ def restore_state(ckpt_dir: str, template: Any, step: Optional[int] = None) -> A
         f"no restorable checkpoints under {ckpt_dir}"
         + (f" (tried: {'; '.join(errors)})" if errors else "")
     )
+
+
+# ------------------------------------------------- ranked checkpoint walk
+#
+# The main-dir + anchors restore order that both training resume and guard
+# rollback use (moved here from train.loop so the serving subsystem can
+# walk checkpoints without importing the training loops).
+
+# Anchor checkpoints (--anchor_every) live in a subdirectory of ckpt_dir:
+# nothing ever prunes or overwrites there, so under repeated divergence the
+# rollback distance is bounded by the anchor cadence even if every
+# checkpoint in the main directory has been torn, poisoned, or pruned.
+ANCHOR_SUBDIR = "anchors"
+
+
+def anchor_dir(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, ANCHOR_SUBDIR)
+
+
+def ranked_checkpoints(ckpt_dir: str):
+    """Every valid checkpoint across the main dir and its anchors as
+    ``(step, is_main, source, dir)``, newest step first (ties — a step
+    saved to both dirs — prefer the main dir)."""
+    ranked = []
+    for src, d in (("checkpoint", ckpt_dir), ("anchor", anchor_dir(ckpt_dir))):
+        for s in valid_steps(d):
+            ranked.append((s, src == "checkpoint", src, d))
+    ranked.sort(reverse=True)
+    return ranked
+
+
+def restore_newest(ckpt_dir: str, template: Any = None, ranked=None):
+    """Restore the newest step that validates AND restores, ranked by
+    STEP across the main dir and the anchors dir; ``(state, source)`` or
+    None.  Ranking whole directories instead would let a size-valid but
+    digest-corrupt newest main checkpoint drag the restore to an
+    arbitrarily old main-dir step while a newer valid anchor sits ignored
+    — exactly the rollback-distance bound anchors exist to provide.
+    Plain resume, guard rollback, AND the serving engine's checkpoint
+    load go through this walk, so every recovery/consumer path agrees on
+    what "newest" means.  ``ranked`` reuses a :func:`ranked_checkpoints`
+    walk the caller already paid for (validation stats every
+    manifest-listed file — costly on networked storage).
+
+    ``template=None`` selects the template-free loose restore
+    (:func:`restore_tree`) — the serving path, which has no optimizer and
+    therefore no full ``TrainState`` pytree to shape the read.
+    """
+    if ranked is None:
+        ranked = ranked_checkpoints(ckpt_dir)
+    errors = []
+    for s, _, src, d in ranked:
+        try:
+            if template is None:
+                return restore_tree(os.path.join(_root(d), str(s))), src
+            return restore_state(d, template, step=s), src
+        except (OSError, ValueError) as e:
+            errors.append(f"{src} step {s}: {e}")
+            continue
+    if errors:
+        # Every candidate failed — say WHY before the caller dies with a
+        # bare "no restorable checkpoints": an opt-state STRUCTURE
+        # mismatch (e.g. artifacts written by an older revision) needs a
+        # very different operator response than torn bytes.
+        log.warning(
+            "no checkpoint under %s restored; per-candidate errors: %s",
+            ckpt_dir, " | ".join(errors[:4]),
+        )
+    return None
+
+
+# ---------------------------------------------- template-free (loose) read
+#
+# The serving engine restores params + batch_stats out of a TRAINING
+# checkpoint without reconstructing the optimizer: it cannot build the
+# TrainState template the strict restore path shapes its read with (the
+# opt-state structure depends on the training recipe, which a server
+# neither knows nor needs).  Both on-disk formats support a structure-free
+# read: Orbax restores with its own saved metadata when no abstract tree
+# is given, and the host-shard manifest records every leaf's keystr path.
+
+_KEYSTR_TOKEN = re.compile(
+    r"\.([A-Za-z_]\w*)|\['([^']*)'\]|\[\"([^\"]*)\"\]|\[(\d+)\]"
+)
+
+
+def keystr_to_path(keystr: str) -> Tuple[str, ...]:
+    """Parse a ``jax.tree_util.keystr`` string into a key tuple.
+
+    ``.params['conv1']['kernel']`` → ``("params", "conv1", "kernel")`` —
+    attribute access (flax struct dataclass fields) and dict keys
+    normalize to the same plain strings, so a loose restore can rebuild a
+    nested dict regardless of what container held each level at save
+    time.  Raises on unparsable residue rather than silently dropping a
+    path segment (a mis-parsed path would misfile a leaf)."""
+    path: List[str] = []
+    pos = 0
+    for m in _KEYSTR_TOKEN.finditer(keystr):
+        if m.start() != pos:
+            raise ValueError(
+                f"unparsable keystr {keystr!r} at offset {pos}"
+            )
+        path.append(next(g for g in m.groups() if g is not None))
+        pos = m.end()
+    if pos != len(keystr):
+        raise ValueError(f"unparsable keystr {keystr!r} at offset {pos}")
+    return tuple(path)
+
+
+def _restore_tree_host_shards(path: str) -> Any:
+    """Loose host-shard read: rebuild a nested dict from the shard
+    manifest's recorded keystr paths (this process's shard when present,
+    else shard 0 — any shard holds the full replica)."""
+    mine = os.path.join(path, f"shard_{jax.process_index()}")
+    shard_dir = mine if os.path.isdir(mine) else os.path.join(path, "shard_0")
+    shard = _read_shard_manifest(shard_dir)
+    if shard is None:
+        raise ValueError(f"checkpoint {path}: shard manifest missing/torn")
+    with open(os.path.join(shard_dir, _LEAVES_FILE), "rb") as f:
+        blob = f.read()
+    tree: dict = {}
+    for entry in shard["leaves"]:
+        arr = np.frombuffer(
+            blob, dtype=_np_dtype(entry["dtype"]),
+            count=int(np.prod(entry["shape"], dtype=np.int64))
+            if entry["shape"] else 1,
+            offset=entry["offset"],
+        ).reshape(entry["shape"])
+        node = tree
+        keys = keystr_to_path(entry["path"])
+        if not keys:
+            raise ValueError(
+                f"checkpoint {path}: empty leaf path in shard manifest"
+            )
+        for key in keys[:-1]:
+            node = node.setdefault(key, {})
+        node[keys[-1]] = arr
+    return tree
+
+
+def adapt_tree(loose: Any, template: Any, what: str = "checkpoint") -> Any:
+    """Re-type a loose nested-dict tree onto ``template``'s pytree
+    structure, matching leaves by normalized key path.
+
+    A template-free restore comes back as plain nested dicts — flax
+    struct dataclasses (whitening/BN stat structs) lose their types in
+    both on-disk formats — but ``model.apply`` needs the REAL node types.
+    The serving engine builds ``template`` with a one-time ``model.init``
+    (structure only; its values are discarded) and this grafts the saved
+    arrays onto it.  Shape mismatches and missing paths raise with the
+    offending path named — a served model quietly built from misfiled
+    leaves would be the worst kind of wrong.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tleaf in flat:
+        keys = keystr_to_path(jax.tree_util.keystr(path))
+        node = loose
+        for key in keys:
+            if not (hasattr(node, "keys") and key in node):
+                raise ValueError(
+                    f"{what}: missing leaf {'/'.join(keys)} "
+                    f"(template/model structure mismatch)"
+                )
+            node = node[key]
+        arr = np.asarray(node)
+        # Template leaves may be abstract (jax.eval_shape output) — read
+        # .shape directly rather than materializing them.
+        want = tuple(
+            tleaf.shape if hasattr(tleaf, "shape") else np.shape(tleaf)
+        )
+        if tuple(arr.shape) != want:
+            raise ValueError(
+                f"{what}: leaf {'/'.join(keys)} has shape "
+                f"{tuple(arr.shape)}; the model expects {want}"
+            )
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_tree(path: str) -> Any:
+    """Read one finalized checkpoint as a nested dict of host numpy
+    arrays, with NO template — both on-disk formats.  The params-subtree
+    digest is verified against the manifest exactly like the strict path
+    (params save as a plain dict, so the loose subtree's flatten order —
+    and therefore its digest — matches the recorded one bit-for-bit).
+    """
+    manifest = _read_manifest(path)
+    if manifest is not None and manifest.get("format") == HOST_SHARD_FORMAT:
+        restored = _restore_tree_host_shards(path)
+    else:
+        def _read():
+            with ocp.StandardCheckpointer() as ckptr:
+                return ckptr.restore(path)
+
+        restored = _with_retries(_read, f"checkpoint loose-restore {path}")
+    want = (manifest or {}).get("params_digest")
+    if want is not None and isinstance(restored, dict) and "params" in restored:
+        got = params_digest(restored["params"])
+        if got != want:
+            raise ValueError(
+                f"checkpoint {path} failed digest validation "
+                f"({got[:12]}… != manifest {want[:12]}…)"
+            )
+    return restored

@@ -12,7 +12,60 @@ import json
 import os
 import sys
 import time
-from typing import IO, Optional
+from typing import IO, Iterable, Optional, Sequence
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (inclusive), dependency-free.
+
+    The ONE percentile definition every latency report in this repo uses
+    — serving access records, consensus decide latencies, eval dispatch
+    intervals, the serve bench — so a p99 printed by one tool is
+    comparable to a p99 printed by another.  Nearest-rank (not
+    interpolated): an actually-observed sample, which is what a latency
+    SLO talks about.  ``values`` need not be sorted; raises on empty
+    input (an absent percentile must not silently read as 0 ms).
+    """
+    vals = sorted(float(v) for v in values)
+    return _nearest_rank(vals, q)
+
+
+def _nearest_rank(sorted_vals: Sequence[float], q: float) -> float:
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q must be in [0, 100], got {q}")
+    if not sorted_vals:
+        raise ValueError("percentile of empty sequence")
+    if q == 0.0:
+        return sorted_vals[0]
+    import math
+
+    # Nearest-rank: ceil(q/100 * N), 1-indexed.  The epsilon absorbs float
+    # dust like 0.29*100 -> 28.999... so exact-boundary ranks stay exact.
+    rank = math.ceil(q * len(sorted_vals) / 100.0 - 1e-9)
+    rank = max(1, min(len(sorted_vals), rank))
+    return sorted_vals[rank - 1]
+
+
+def percentile_summary(
+    values: Iterable[float],
+    qs: Sequence[float] = (50.0, 95.0, 99.0),
+    prefix: str = "p",
+    round_to: int = 3,
+) -> dict:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` over ``values``.
+
+    Empty input returns ``{}`` — callers emit no percentile fields rather
+    than fabricated zeros.  Keys drop a trailing ``.0`` (``p99`` not
+    ``p99.0``); non-integral quantiles keep their decimals (``p99.9``).
+    """
+    vals = sorted(float(v) for v in values)  # ONE sort for all quantiles
+    if not vals:
+        return {}
+    out = {}
+    for q in qs:
+        name = f"{prefix}{int(q)}" if float(q).is_integer() else f"{prefix}{q}"
+        out[name] = round(_nearest_rank(vals, q), round_to)
+    return out
 
 
 class MetricLogger:

@@ -39,6 +39,27 @@ def test_bench_no_probe_emits_contract_json():
     assert record["flops_source"] in ("xla_cost_analysis", "analytic_estimate")
 
 
+def test_bench_lenet_eval_phase_supported():
+    """ISSUE-7 satellite: ``--phase eval`` must cover the digits forward
+    too (it used to hard-error for --model lenet), so the serving
+    workload's single-chip floor is measurable for both models."""
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--model", "lenet", "--phase", "eval",
+         "--steps", "3", "--no-probe"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = _last_json_line(proc.stdout)
+    assert REQUIRED_KEYS <= set(record)
+    assert record["metric"] == "lenet_dwt_eval_imgs_per_sec"
+    assert record["value"] > 0
+    # Eval is not the anchored flagship metric: no baseline ratio games.
+    assert record["vs_baseline"] == 1.0
+    assert record["baseline_imgs_per_sec"] is None
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(
     __import__("importlib.util", fromlist=["util"]).find_spec("axon") is None,

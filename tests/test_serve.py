@@ -1,0 +1,643 @@
+"""Serving subsystem tests (ISSUE-7).
+
+Tier-1 (fast): the pure batcher planner under a fake clock (the
+``test_bench_contract`` ``_FakeClock`` pattern — no sleeps, no timing
+flake), load-shed admission, bitwise served-logits parity vs the
+eval-mode forward at every bucket size (padded tails included),
+checkpoint-restore-into-server for BOTH on-disk formats, the shared
+percentile helper, one batcher→engine→metrics smoke, and the SIGTERM
+graceful-drain subprocess proof.
+
+Slow-marked (tools/t1_budget.py discipline): sustained open-loop load
+and the multi-device mesh fan-out subprocess matrix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------- percentile unit
+
+def test_percentile_nearest_rank():
+    from dwt_tpu.utils.metrics import percentile
+
+    vals = list(range(1, 101))  # 1..100
+    assert percentile(vals, 50) == 50
+    assert percentile(vals, 95) == 95
+    assert percentile(vals, 99) == 99
+    assert percentile(vals, 100) == 100
+    assert percentile(vals, 0) == 1
+    # Nearest-rank returns an OBSERVED sample, order-independent.
+    assert percentile([9.0, 1.0, 5.0], 50) == 5.0
+    assert percentile([7.5], 99) == 7.5
+    with pytest.raises(ValueError):
+        percentile([], 50)
+    with pytest.raises(ValueError):
+        percentile([1.0], 123)
+
+
+def test_percentile_summary_keys_and_empty():
+    from dwt_tpu.utils.metrics import percentile_summary
+
+    out = percentile_summary([3.0, 1.0, 2.0], (50.0, 99.0), prefix="e2e_ms_p")
+    assert out == {"e2e_ms_p50": 2.0, "e2e_ms_p99": 3.0}
+    # Empty input emits NO fields — absent percentiles must not read as 0.
+    assert percentile_summary([], (50.0,)) == {}
+
+
+# ---------------------------------------------------------- batcher planner
+
+class _FakeClock:
+    """Deterministic stand-in for time.monotonic (the deadline source)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_plan_dispatch_fills_largest_bucket_immediately():
+    from dwt_tpu.serve.batcher import plan_dispatch
+
+    buckets = (1, 8, 32)
+    # 32 queued samples fill the largest bucket: dispatch NOW, deadline
+    # irrelevant.
+    assert plan_dispatch([8, 8, 16], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0) == 3
+    # Order-preserving prefix: 8+8+20 > 32, so only the first two go even
+    # though dropping the middle one would pack better.
+    assert plan_dispatch([8, 8, 20], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0) == 2
+
+
+def test_plan_dispatch_waits_until_deadline():
+    from dwt_tpu.serve.batcher import plan_dispatch
+
+    buckets = (1, 8, 32)
+    # Under-filled and under deadline: wait.
+    assert plan_dispatch([3], buckets, now=0.004, oldest_t=0.0,
+                         max_delay_s=0.005) == 0
+    # Deadline reached: flush what's queued.
+    assert plan_dispatch([3], buckets, now=0.005, oldest_t=0.0,
+                         max_delay_s=0.005) == 1
+    # Empty queue: nothing to do.
+    assert plan_dispatch([], buckets, now=1.0, oldest_t=None,
+                         max_delay_s=0.005) == 0
+
+
+def test_plan_dispatch_rejects_unbucketable_head():
+    from dwt_tpu.serve.batcher import plan_dispatch
+
+    with pytest.raises(ValueError):
+        plan_dispatch([64], (1, 8, 32), now=0.0, oldest_t=0.0,
+                      max_delay_s=0.01)
+
+
+def test_batcher_deadline_coalescing_fake_clock():
+    from dwt_tpu.serve.batcher import MicroBatcher
+
+    clock = _FakeClock()
+    b = MicroBatcher(buckets=(1, 4, 8), max_batch_delay_ms=5.0,
+                     max_queue_items=64, clock=clock)
+    f1 = b.submit(np.ones((1, 2, 2, 1), np.float32))
+    f2 = b.submit(np.full((2, 2, 2, 1), 2.0, np.float32))
+    # Before the deadline nothing dispatches (3 < largest bucket 8).
+    assert b.next_batch(timeout=0) is None
+    clock.t = 0.0051  # oldest request's deadline passed
+    pb = b.next_batch(timeout=0)
+    assert pb is not None
+    assert pb.bucket == 4 and pb.real_n == 3  # smallest bucket that fits
+    # Pad-and-mask: the tail repeats the last REAL row, masked out.
+    assert pb.mask.tolist() == [True, True, True, False]
+    np.testing.assert_array_equal(pb.x[3], pb.x[2])
+    assert pb.slices == [(0, 1), (1, 3)]
+    assert not f1.done() and not f2.done()  # resolution is the dispatcher's
+    b.close()
+    assert b.next_batch(timeout=0) is None  # closed + drained
+
+
+def test_batcher_full_bucket_dispatches_without_deadline():
+    from dwt_tpu.serve.batcher import MicroBatcher
+
+    clock = _FakeClock()
+    b = MicroBatcher(buckets=(1, 4), max_batch_delay_ms=60_000.0,
+                     max_queue_items=64, clock=clock)
+    for _ in range(4):
+        b.submit(np.ones((1, 2, 2, 1), np.float32))
+    pb = b.next_batch(timeout=0)  # full largest bucket: no wait
+    assert pb is not None and pb.bucket == 4 and pb.real_n == 4
+    assert pb.mask.all()
+
+
+def test_batcher_load_shedding_and_drain():
+    from dwt_tpu.serve.batcher import MicroBatcher, ShedError
+
+    clock = _FakeClock()
+    b = MicroBatcher(buckets=(1, 4), max_batch_delay_ms=5.0,
+                     max_queue_items=4, clock=clock)
+    for _ in range(4):
+        b.submit(np.ones((1, 2, 2, 1), np.float32))
+    with pytest.raises(ShedError) as exc:
+        b.submit(np.ones((1, 2, 2, 1), np.float32))
+    assert exc.value.retry_after_ms >= 1
+    assert exc.value.queued == 4
+    # Drain: queued work still dispatches (no deadline games), new
+    # arrivals shed with retry-after.
+    b.drain()
+    with pytest.raises(ShedError) as drain_exc:
+        b.submit(np.ones((1, 2, 2, 1), np.float32))
+    # Drain is permanent for this process: the retry-after must be a real
+    # back-off, not the queue-depth estimate (1 ms once flushed).
+    assert drain_exc.value.retry_after_ms >= 1000
+    pb = b.next_batch(timeout=0)
+    assert pb is not None and pb.real_n == 4
+    assert b.next_batch(timeout=0) is None  # drained empty
+
+
+def test_access_log_write_failure_does_not_raise():
+    """A full disk degrades to lost access records — record() runs on the
+    dispatcher thread and must never kill it over logging I/O."""
+    from dwt_tpu.serve.metrics import AccessLog
+
+    class _FullDisk:
+        def write(self, s):
+            raise OSError(28, "No space left on device")
+
+    alog = AccessLog(stream=_FullDisk())
+    alog.record("ok", 1, e2e_ms=1.0)  # must not raise
+    alog.record("ok", 2, e2e_ms=2.0)
+    s = alog.summary()
+    assert s["served_requests"] == 2 and s["served_imgs"] == 3
+
+
+def test_batcher_rejects_oversized_and_malformed():
+    from dwt_tpu.serve.batcher import MicroBatcher
+
+    b = MicroBatcher(buckets=(1, 4), max_batch_delay_ms=1.0)
+    with pytest.raises(ValueError):
+        b.submit(np.ones((5, 2, 2, 1), np.float32))  # > largest bucket
+    with pytest.raises(ValueError):
+        b.submit(np.ones((2, 2), np.float32)[0])  # not [n, ...sample]
+    with pytest.raises(ValueError):
+        MicroBatcher(buckets=(4, 1))  # not ascending
+
+
+# ------------------------------------------------------------ shared state
+
+@pytest.fixture(scope="module")
+def tiny_serve_setup():
+    """One LeNet state + engine for every engine-level test (compiles are
+    the cost; sharing keeps this file inside the tier-1 budget)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.train import create_train_state
+
+    model = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    engine = ServeEngine(
+        model, state.params, state.batch_stats, (28, 28, 1),
+        buckets=(1, 4, 8),
+    )
+    return model, state, engine
+
+
+# ------------------------------------------------- served-logits parity
+
+def test_served_logits_bitwise_parity_every_bucket(tiny_serve_setup):
+    """Acceptance: served logits are BITWISE the eval-mode forward's for
+    the same params/whiten_cache at every bucket size, including padded
+    tails.  The oracle is an independently-jitted eval-mode
+    ``model.apply`` (frozen running stats + the precomputed whiten
+    cache) at the bucket shape."""
+    import jax
+
+    from dwt_tpu.train.evalpipe import make_whiten_cache_fn
+    from dwt_tpu.train.steps import eval_variables
+
+    model, state, engine = tiny_serve_setup
+    cache = make_whiten_cache_fn("cholesky")(state.batch_stats)
+    oracle = jax.jit(
+        lambda p, s, c, x: model.apply(
+            eval_variables(p, s, c), x, train=False
+        )
+    )
+    rng = np.random.default_rng(7)
+    for bucket in engine.buckets:
+        for n in {1, bucket - 1, bucket} - {0}:
+            x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+            served = engine.infer(x, bucket=bucket)
+            padded = x
+            if n < bucket:
+                padded = np.concatenate(
+                    [x, np.repeat(x[-1:], bucket - n, axis=0)]
+                )
+            want = np.asarray(
+                oracle(state.params, state.batch_stats, cache, padded)
+            )[:n]
+            np.testing.assert_array_equal(
+                served, want,
+                err_msg=f"bucket={bucket} n={n} served logits diverge "
+                "from the eval-mode forward",
+            )
+
+
+def test_served_counters_match_evalpipe(tiny_serve_setup):
+    """Served responses reduce to EXACTLY the eval pipeline's counters
+    on the same dataset (count exact, accuracy identical — the masked
+    padded tails contribute nothing on either path)."""
+    from dwt_tpu.data import ArrayDataset
+    from dwt_tpu.serve import ServeClient
+    from dwt_tpu.train.evalpipe import EvalPipeline
+
+    model, state, engine = tiny_serve_setup
+    rng = np.random.default_rng(3)
+    n = 27  # deliberately ragged vs every bucket and the eval batch
+    xs = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(n,))
+    dataset = ArrayDataset(xs, ys)
+
+    evalp = EvalPipeline(
+        lambda axis_name=None: model, test_batch_size=8, eval_k=2
+    )
+    ref = evalp.evaluate(state, dataset)
+    assert ref["count"] == n
+    # The test record now carries dispatch-interval percentiles from the
+    # shared helper (uniform p50/p99 reporting satellite).
+    assert "dispatch_ms_p50" in ref and "dispatch_ms_p99" in ref
+
+    client = ServeClient(engine, max_batch_delay_ms=1.0)
+    try:
+        futures = [
+            client.submit(xs[i:i + 5]) for i in range(0, n, 5)
+        ]
+        logits = np.concatenate([f.result(60.0) for f in futures])
+    finally:
+        client.close()
+    assert logits.shape == (n, 10)
+    correct = int((np.argmax(logits, axis=-1) == ys).sum())
+    assert ref["accuracy"] == pytest.approx(100.0 * correct / n, abs=1e-9)
+
+
+# ------------------------------------------- checkpoint-restore-into-server
+
+def test_restore_into_server_orbax_format(tmp_path, tiny_serve_setup):
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.utils import save_state
+
+    model, state, engine = tiny_serve_setup
+    ck = str(tmp_path / "ck")
+    save_state(ck, 5, state)
+    restored = ServeEngine.from_checkpoint(ck, model, (28, 28, 1),
+                                           buckets=(4,))
+    assert restored.source == "checkpoint"
+    x = np.random.default_rng(1).normal(size=(3, 28, 28, 1)).astype(
+        np.float32
+    )
+    np.testing.assert_array_equal(restored.infer(x), engine.infer(x))
+
+
+def test_restore_into_server_host_shard_format(tmp_path, tiny_serve_setup):
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.utils.checkpoint import (
+        host_fetch,
+        promote_host_shards,
+        save_host_shard,
+    )
+
+    model, state, engine = tiny_serve_setup
+    ck = str(tmp_path / "ck")
+    assert save_host_shard(ck, 7, host_fetch(state), 0)
+    promote_host_shards(ck, 7, 1)
+    restored = ServeEngine.from_checkpoint(ck, model, (28, 28, 1),
+                                           buckets=(4,))
+    x = np.random.default_rng(2).normal(size=(4, 28, 28, 1)).astype(
+        np.float32
+    )
+    np.testing.assert_array_equal(restored.infer(x), engine.infer(x))
+
+
+def test_restore_into_server_wrong_model_fails_loudly(
+    tmp_path, tiny_serve_setup
+):
+    """A checkpoint grafted onto a structurally different model must
+    raise with the offending path named, not serve garbage."""
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.utils import save_state
+
+    model, state, _ = tiny_serve_setup
+    ck = str(tmp_path / "ck")
+    save_state(ck, 3, state)
+    wrong = LeNetDWT(group_size=2)  # different whitening group structure
+    with pytest.raises((ValueError, FileNotFoundError)):
+        ServeEngine.from_checkpoint(ck, wrong, (28, 28, 1), buckets=(1,))
+
+
+def test_keystr_to_path_roundtrip():
+    import jax
+
+    from dwt_tpu.utils.checkpoint import keystr_to_path
+
+    tree = {"params": {"conv1": {"kernel": 1}}, "nested": [2, 3]}
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    paths = [keystr_to_path(jax.tree_util.keystr(p)) for p, _ in flat]
+    assert ("params", "conv1", "kernel") in paths
+    assert ("nested", "0") in paths
+    with pytest.raises(ValueError):
+        keystr_to_path("garbage!")
+
+
+# ------------------------------------------------------------- fast smoke
+
+def test_smoke_batcher_engine_metrics(tiny_serve_setup):
+    """Tier-1 smoke: a few mixed-size requests through
+    batcher → engine → metrics; access records carry the documented
+    schema and the summary aggregates with the shared percentiles."""
+    from dwt_tpu.serve import ServeClient
+    from dwt_tpu.serve.metrics import AccessLog
+
+    model, state, engine = tiny_serve_setup
+    access = AccessLog()
+    client = ServeClient(engine, max_batch_delay_ms=1.0, access_log=access)
+    rng = np.random.default_rng(11)
+    try:
+        futs = [
+            client.submit(rng.normal(size=(k, 28, 28, 1)).astype(np.float32))
+            for k in (1, 2, 3, 1)
+        ]
+        for k, f in zip((1, 2, 3, 1), futs):
+            assert f.result(60.0).shape == (k, 10)
+    finally:
+        client.close()
+    summary = access.summary()
+    assert summary["served_requests"] == 4
+    assert summary["served_imgs"] == 7
+    assert summary["shed_requests"] == 0
+    for key in ("e2e_ms_p50", "e2e_ms_p95", "e2e_ms_p99",
+                "queue_ms_p50", "device_ms_p50", "imgs_per_s"):
+        assert key in summary, key
+
+
+def test_mismatched_sample_shape_rejected_at_admission(tiny_serve_setup):
+    """A request with the wrong sample dims must 400 at submit — not
+    reach the dispatcher, where its np.concatenate failure would take
+    down every other rider of the coalesced batch."""
+    from dwt_tpu.serve import ServeClient
+
+    model, state, engine = tiny_serve_setup
+    client = ServeClient(engine, max_batch_delay_ms=50.0)
+    try:
+        ok = client.submit(np.zeros((1, 28, 28, 1), np.float32))
+        with pytest.raises(ValueError, match="input shape"):
+            client.submit(np.zeros((1, 28, 28, 3), np.float32))
+        with pytest.raises(ValueError):
+            client.submit(np.zeros((1, 14, 14), np.float32))
+        # The well-formed request sharing the window still serves.
+        assert ok.result(60.0).shape == (1, 10)
+        assert client.dispatcher_alive
+    finally:
+        client.close()
+
+
+def test_cancelled_future_does_not_kill_dispatcher(tiny_serve_setup):
+    """fut.cancel() on a queued request must not blow up the dispatcher
+    when it later resolves the batch (set_result on a cancelled Future
+    raises InvalidStateError) — other riders and later requests still
+    serve."""
+    from dwt_tpu.serve import ServeClient
+
+    model, state, engine = tiny_serve_setup
+    client = ServeClient(engine, max_batch_delay_ms=300.0)
+    try:
+        one = np.zeros((1, 28, 28, 1), np.float32)
+        f1 = client.submit(one)
+        f2 = client.submit(one)
+        f1.cancel()  # races the dispatch; either outcome must be survivable
+        assert f2.result(60.0).shape == (1, 10)
+        f3 = client.submit(one)
+        assert f3.result(60.0).shape == (1, 10)
+        assert client.dispatcher_alive
+    finally:
+        client.close()
+
+
+def test_engine_infer_rejects_empty_and_oversize(tiny_serve_setup):
+    """The engine's unbatched convenience path shares the batcher's
+    admission contract: n=0 and n>bucket fail with a clear ValueError,
+    not a low-level AOT shape mismatch."""
+    model, state, engine = tiny_serve_setup
+    empty = np.zeros((0, 28, 28, 1), np.float32)
+    with pytest.raises(ValueError, match="at least one sample"):
+        engine.infer(empty)
+    with pytest.raises(ValueError, match="samples for bucket"):
+        engine.infer(empty, bucket=engine.buckets[0])
+    big = np.zeros((max(engine.buckets) + 1, 28, 28, 1), np.float32)
+    with pytest.raises(ValueError, match="largest bucket"):
+        engine.infer(big)
+    with pytest.raises(ValueError, match="bucket"):
+        engine.infer(big, bucket=engine.buckets[0])
+
+
+def test_dispatcher_death_fails_fast_and_unhealthy():
+    """A staging/placement failure must not strand waiters until their
+    client timeout: the dispatcher fails every pending future promptly,
+    closes admission, and reports unhealthy."""
+    from dwt_tpu.serve import ServeClient
+
+    class _BrokenEngine:
+        buckets = (1, 4)
+        input_shape = (28, 28, 1)
+        step = None
+
+        def stage(self, x):
+            raise RuntimeError("device exploded")
+
+        def forward(self, x, bucket):  # pragma: no cover - never reached
+            raise AssertionError("forward after failed staging")
+
+    client = ServeClient(_BrokenEngine(), max_batch_delay_ms=1.0)
+    fut = client.submit(np.zeros((1, 28, 28, 1), np.float32))
+    with pytest.raises(RuntimeError, match="device exploded"):
+        fut.result(timeout=30.0)
+    client._dispatcher.join(timeout=30.0)
+    assert not client.dispatcher_alive
+    assert isinstance(client.dispatcher_error, RuntimeError)
+    with pytest.raises(RuntimeError):  # admission closed, not hanging
+        client.submit(np.zeros((1, 28, 28, 1), np.float32))
+    assert client.access_log.error_requests == 1
+
+
+# ---------------------------------------------------- SIGTERM drain proof
+
+def _post_infer(port: int, x, timeout=30.0):
+    body = json.dumps({"inputs": np.asarray(x).tolist()}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/infer", data=body, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_sigterm_drains_cleanly_under_load(tmp_path):
+    """Acceptance: SIGTERM during load → in-flight requests complete,
+    the queue drains (or sheds with retry-after), exit 0, no torn
+    responses — the serving mirror of the resilience SIGTERM tests."""
+    access = str(tmp_path / "access.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.serve.server",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2", "--port", "0",
+         "--access_log", access],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "serve_ready"
+        port = ready["port"]
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 28, 28, 1)).astype(np.float32)
+        # Warm the path, then SIGTERM with requests in flight.
+        status, payload = _post_infer(port, x)
+        assert status == 200 and len(payload["logits"]) == 1
+
+        import threading
+
+        results = []
+
+        def _load():
+            for _ in range(40):
+                try:
+                    results.append(_post_infer(port, x, timeout=30.0))
+                except (ConnectionError, OSError):
+                    results.append(("conn", None))
+
+        loader = threading.Thread(target=_load)
+        loader.start()
+        time.sleep(0.15)  # mid-load
+        proc.send_signal(signal.SIGTERM)
+        loader.join(timeout=120)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        # Every HTTP response was whole: 200 with logits, or an explicit
+        # drain/shed answer carrying retry-after — never torn JSON.
+        served = shed = 0
+        for status, payload in results:
+            if status == 200:
+                assert payload and "logits" in payload
+                served += 1
+            elif status in (429, 503):
+                assert "retry_after_ms" in payload
+                shed += 1
+            else:
+                assert status == "conn"  # listener already down
+        assert served >= 1
+        out = proc.stdout.read()
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["kind"] == "serve_summary"
+        assert summary["served_requests"] >= served
+        # The access log is intact JSONL (no torn records).
+        for line in open(access).read().splitlines():
+            assert json.loads(line)["kind"] == "access"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# -------------------------------------------------------------- slow tier
+
+@pytest.mark.slow
+def test_sustained_overload_sheds_not_queues(tiny_serve_setup):
+    """Open-loop overload (tools/serve_bench.run_load): offered load far
+    past CPU capacity must shed — bounded queue, nonzero shed rate, and
+    the SERVED tail still bounded by queue_cap/throughput, instead of
+    latencies growing with the offered load (the unbounded-queue death
+    spiral the admission control exists to prevent)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from serve_bench import run_load
+
+    from dwt_tpu.serve import ServeClient
+
+    model, state, engine = tiny_serve_setup
+    client = ServeClient(
+        engine, max_batch_delay_ms=2.0, max_queue_items=64
+    )
+    try:
+        client.infer(np.zeros((1, 28, 28, 1), np.float32))  # warm
+        record = run_load(
+            client, (28, 28, 1), offered=20_000.0, seconds=1.5,
+            request_n=1,
+        )
+    finally:
+        client.close()
+    assert record["shed"] > 0 and record["shed_rate"] > 0.2
+    assert record["served"] > 0
+    # Bounded tail: with a 64-sample queue cap the worst served request
+    # waited roughly cap/throughput, not offered-load-many seconds.
+    assert record["e2e_ms_p99"] < 10_000
+
+
+@pytest.mark.slow
+def test_mesh_replica_fanout_two_devices():
+    """--data_parallel fan-out on a forced 2-device host: bucket sizes
+    round up to mesh multiples and served logits match the unsharded
+    engine to f32 reassociation tolerance (a different XLA program;
+    bitwise is per-program)."""
+    code = r"""
+import numpy as np, jax, jax.numpy as jnp, optax, json
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.train import create_train_state
+from dwt_tpu.serve import ServeEngine
+from dwt_tpu.parallel import make_mesh
+
+assert jax.device_count() == 2
+model = LeNetDWT(group_size=4)
+rng = np.random.default_rng(0)
+sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+state = create_train_state(model, jax.random.key(0), sample, optax.identity())
+x = rng.normal(size=(5, 28, 28, 1)).astype(np.float32)
+ref = ServeEngine(model, state.params, state.batch_stats, (28, 28, 1),
+                  buckets=(8,)).infer(x)
+eng = ServeEngine(model, state.params, state.batch_stats, (28, 28, 1),
+                  buckets=(1, 8), mesh=make_mesh())
+assert eng.buckets == (2, 8), eng.buckets  # 1 rounded up to the mesh
+out = eng.infer(x)
+np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-3)
+print("OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout

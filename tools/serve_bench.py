@@ -1,0 +1,179 @@
+"""Open-loop serving load generator: latency vs offered load (ISSUE-7).
+
+Closed-loop clients (send, wait, send) hide queueing collapse — the
+client slows down exactly when the server does, so the measured latency
+stays flat while real users would be timing out.  This bench is
+OPEN-loop: request arrival times are a Poisson process at the offered
+rate, drawn up front and honored regardless of how the server is doing
+(the "millions of users" model — arrivals don't care about your queue).
+
+For each offered load it reports ONE JSON line::
+
+    {"kind": "serve_bench", "offered_imgs_per_s": 400,
+     "achieved_imgs_per_s": 398.2, "served": 1991, "shed": 0,
+     "shed_rate": 0.0, "e2e_ms_p50": 3.1, "e2e_ms_p95": 4.9,
+     "e2e_ms_p99": 6.2, "queue_ms_p50": ..., "device_ms_p50": ...}
+
+sweeping ``--loads`` (imgs/s).  Run one load well past saturation to see
+the load-shedding contract: shed_rate rises, the SERVED tail latency
+stays bounded (the queue cannot grow past ``--max_queue``), and the
+process stays healthy — instead of the unbounded-queue death spiral.
+
+In-process by default (``ServeClient`` — no HTTP overhead, measures the
+batcher+engine path the server wraps).  CPU numbers are a functional
+floor; the chip round re-runs this against the TPU roofline (PERF.md
+"Serving path").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# Allow `python tools/serve_bench.py` from any cwd in a source checkout.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _build_client(args):
+    # One engine-construction path for the server AND the bench: the
+    # bench must measure exactly the engine `dwt-serve` would run.
+    from dwt_tpu.serve.server import ServeClient, build_engine
+
+    engine = build_engine(args)
+    client = ServeClient(
+        engine,
+        max_batch_delay_ms=args.max_batch_delay_ms,
+        max_queue_items=args.max_queue,
+    )
+    return client, engine.input_shape
+
+
+def run_load(client, input_shape, offered: float, seconds: float,
+             request_n: int, seed: int = 0) -> dict:
+    """One open-loop measurement at ``offered`` imgs/s for ``seconds``.
+
+    Arrivals are Poisson (exponential gaps) in REQUEST units
+    (``offered / request_n`` requests/s); each request is ``request_n``
+    images of noise (serving cost is shape-, not content-, dependent).
+    Shed requests are counted, not retried — the open-loop contract.
+    """
+    from dwt_tpu.serve.batcher import ShedError
+
+    rng = np.random.default_rng(seed)
+    req_rate = offered / request_n
+    n_requests = max(1, int(round(req_rate * seconds)))
+    gaps = rng.exponential(1.0 / req_rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    x = rng.normal(size=(request_n,) + tuple(input_shape)).astype(np.float32)
+
+    shed, errors = 0, 0
+    futures = []
+    # Per-request latencies come from the ACCESS LOG (stamped at
+    # resolution time by the dispatcher, before the future resolves),
+    # not from harvest-time arithmetic — a request that resolved seconds
+    # before its future is read must not book those idle seconds as
+    # latency.  Count-diffed windows isolate THIS load point's samples
+    # from earlier sweep points and the warmup.
+    before = client.access_log.windows()
+
+    def _submit_all():
+        nonlocal shed
+        t0 = time.perf_counter()
+        for t_arr in arrivals:
+            delay = t0 + t_arr - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(client.submit(x))
+            except ShedError:
+                shed += 1
+
+    submitter = threading.Thread(target=_submit_all, daemon=True)
+    t_start = time.perf_counter()
+    submitter.start()
+    submitter.join()
+    # Harvest: every accepted request must resolve (bounded queue + the
+    # dispatcher draining it guarantee this terminates promptly).
+    for fut in futures:
+        try:
+            fut.result(timeout=60.0)
+        except Exception:
+            errors += 1
+    elapsed = time.perf_counter() - t_start
+    after = client.access_log.windows()
+    delta = after["served_requests"] - before["served_requests"]
+
+    from dwt_tpu.utils.metrics import percentile_summary
+
+    served = len(futures) - errors
+    total = served + shed + errors
+    record = {
+        "kind": "serve_bench",
+        "offered_imgs_per_s": round(offered, 1),
+        "duration_s": round(elapsed, 3),
+        "request_n": request_n,
+        "requests": total,
+        "served": served,
+        "shed": shed,
+        "errors": errors,
+        "shed_rate": round(shed / max(total, 1), 4),
+        "achieved_imgs_per_s": round(
+            served * request_n / max(elapsed, 1e-9), 1
+        ),
+    }
+    for name, qs in (("e2e_ms", (50.0, 95.0, 99.0)),
+                     ("queue_ms", (50.0, 99.0)),
+                     ("device_ms", (50.0, 99.0))):
+        window = after[name][-delta:] if delta > 0 else []
+        record.update(percentile_summary(window, qs, prefix=f"{name}_p"))
+    return record
+
+
+def main(argv=None) -> int:
+    from dwt_tpu.serve.server import build_parser
+
+    p = argparse.ArgumentParser(
+        description="open-loop (Poisson) serving load sweep",
+        parents=[build_parser()], conflict_handler="resolve", add_help=True,
+    )
+    p.add_argument("--loads", default="100,200,400,800",
+                   help="comma-separated offered loads (imgs/s) to sweep")
+    p.add_argument("--duration_s", type=float, default=5.0,
+                   help="measurement window per offered load")
+    p.add_argument("--request_n", type=int, default=1,
+                   help="images per request")
+    p.add_argument("--warmup_requests", type=int, default=8,
+                   help="requests served before timing starts")
+    args = p.parse_args(argv)
+
+    client, input_shape = _build_client(args)
+    rng = np.random.default_rng(args.seed)
+    warm = rng.normal(
+        size=(args.request_n,) + tuple(input_shape)
+    ).astype(np.float32)
+    for _ in range(args.warmup_requests):
+        client.infer(warm)
+
+    rc = 0
+    try:
+        for offered in (float(v) for v in args.loads.split(",")):
+            record = run_load(
+                client, input_shape, offered, args.duration_s,
+                args.request_n, seed=args.seed,
+            )
+            print(json.dumps(record), flush=True)
+    finally:
+        client.close(drain=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
