@@ -546,6 +546,8 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         if args.phase != "train":
             model_args += ["--phase", args.phase]
         steps = min(args.steps, 5)
+    if getattr(args, "obs_trace", None):
+        model_args += ["--obs_trace", args.obs_trace]
     cmd = [
         sys.executable,
         os.path.abspath(__file__),
@@ -596,6 +598,13 @@ def main():
         action="store_true",
         help="skip the subprocess backend probe (fallback path)",
     )
+    ap.add_argument(
+        "--obs_trace",
+        default=None,
+        help="span tracing: write a Chrome trace-event JSON of the bench "
+        "run's spans (H2D staging, dispatch waits) to this path for "
+        "tools/obs_report.py; DWT_OBS_TRACE env is the flagless form",
+    )
     ap.add_argument("--fallback-note", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.pallas and args.model != "resnet50":
@@ -636,6 +645,9 @@ def main():
     enable_compile_cache()
     import jax
 
+    from dwt_tpu import obs
+
+    obs.maybe_enable(args.obs_trace)
     if args.model == "lenet":
         batch = args.batch or 32
         if args.phase == "eval":
@@ -741,6 +753,7 @@ def main():
         record["image_size"] = args.image
     if args.fallback_note:
         record["fallback"] = args.fallback_note
+    obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     print(json.dumps(record))
 
 

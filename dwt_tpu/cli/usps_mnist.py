@@ -136,6 +136,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps after each periodic/final save; anchors "
                         "(--anchor_every) and best_* artifacts live in "
                         "separate directories and are never pruned")
+    p.add_argument("--obs_trace", type=str, default=d.obs_trace,
+                   help="span tracing: write a Chrome trace-event JSON of "
+                        "the run's per-phase spans (batch wait / step "
+                        "dispatch / host fetch / consensus / checkpoint) "
+                        "to this path — open in Perfetto or feed "
+                        "tools/obs_report.py; DWT_OBS_TRACE env is the "
+                        "flagless form.  Off by default; disabled spans "
+                        "cost ~one global read")
+    p.add_argument("--heartbeat_every", type=int, default=d.heartbeat_every,
+                   help=">0: emit a heartbeat record (steps/s EWMA, host "
+                        "RSS MB, async-ckpt in-flight depth) every N "
+                        "steps — the cheap always-on liveness signal "
+                        "when full tracing is off.  0 disables")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--expect_accuracy", type=float, default=None,

@@ -103,6 +103,15 @@ class DigitsConfig:
     # training continues, so the later SIGTERM exits fast.
     preempt_notice_file: Optional[str] = None  # notice = this file exists
     preempt_notice_metadata: bool = False  # poll the GCE preempted key
+    # Span tracing (dwt_tpu.obs): write a Chrome trace-event JSON of the
+    # run's per-phase spans to this path (Perfetto/TensorBoard loadable;
+    # analyzed offline by tools/obs_report.py).  None = tracing off
+    # unless DWT_OBS_TRACE is set; disabled spans are near-free.
+    obs_trace: Optional[str] = None
+    # >0: emit a "heartbeat" record (steps/s EWMA, host RSS, async-ckpt
+    # in-flight depth) every N steps — the cheap always-on liveness
+    # signal when full tracing is off.  0 disables.
+    heartbeat_every: int = 100
 
 
 @dataclasses.dataclass
@@ -183,3 +192,7 @@ class OfficeHomeConfig:
     # Preemption notice — see DigitsConfig.preempt_notice_*.
     preempt_notice_file: Optional[str] = None
     preempt_notice_metadata: bool = False
+    # Span tracing / heartbeat records — see DigitsConfig.obs_trace /
+    # heartbeat_every.
+    obs_trace: Optional[str] = None
+    heartbeat_every: int = 100

@@ -421,6 +421,8 @@ def prefetch_to_device(
     """
     import jax
 
+    from dwt_tpu import obs
+
     put = transfer or (lambda item: jax.device_put(item, device))
     q: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
@@ -439,9 +441,23 @@ def prefetch_to_device(
         return False
 
     def producer():
+        # Producer-thread telemetry (dwt_tpu.obs): "batch_build" is the
+        # host-side assembly/augmentation wait on the source iterator,
+        # "h2d_stage" the placement/transfer call.  Both live on THIS
+        # thread's ring, so the attribution report can say whether a
+        # starved consumer was blocked on data or on staging.  When
+        # tracing is off, obs.span is a shared no-op.
         try:
-            for item in iterator:
-                if not _put(put(item)):
+            it = iter(iterator)
+            while True:
+                with obs.span("batch_build", "data"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                with obs.span("h2d_stage", "data"):
+                    staged = put(item)
+                if not _put(staged):
                     return
         except BaseException as e:  # re-raised in the consumer below
             _put((sentinel, e))

@@ -1,0 +1,51 @@
+"""Run-wide telemetry: span tracing, flight recorder, trace export.
+
+Usage at call sites (always safe, near-free when tracing is off)::
+
+    from dwt_tpu import obs
+
+    with obs.span("step_dispatch"):
+        state, metrics = train_step(state, batch)
+
+Gate: ``--obs_trace PATH`` on the CLIs / ``DWT_OBS_TRACE`` env
+(``obs.maybe_enable``).  Export: ``obs.export()`` writes Chrome
+trace-event JSON (Perfetto/TensorBoard loadable).  Flight recorder:
+``obs.flight_dump(dir, reason)`` writes the last few seconds of spans —
+wired into the hang watchdog and divergence-guard event paths.
+
+Span categories (the report tool groups by these):
+
+* ``step`` — top-level, non-overlapping phases of the TRAIN loop's main
+  thread; their sum vs the loop wall time is the attribution table.
+* ``eval`` — eval/stat-collection pipeline internals.
+* ``ckpt`` — checkpoint pipeline (writer-thread writes, host fetch,
+  promotion, barriers).
+* ``data`` — prefetch producer thread (batch assembly, H2D staging).
+* ``serve`` — serving path (admission → plan → build → stage → device →
+  resolve), spans carrying ``bucket``/``req_id`` attrs that correlate
+  with ``AccessLog`` records.
+* ``detail`` — nested sub-phases (guard check, consensus decide) inside
+  a ``step`` span; excluded from the top-level sum.
+"""
+
+from dwt_tpu.obs.spans import (  # noqa: F401
+    NULL_SPAN,
+    Tracer,
+    configure,
+    disable,
+    enabled,
+    export_path,
+    get_tracer,
+    maybe_enable,
+    record_complete,
+    snapshot,
+    span,
+    traced_iter,
+)
+from dwt_tpu.obs.export import (  # noqa: F401
+    FLIGHT_WINDOW_S,
+    export,
+    flight_dump,
+    to_chrome_trace,
+    validate_chrome_trace,
+)

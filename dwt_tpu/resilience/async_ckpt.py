@@ -67,6 +67,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from dwt_tpu import obs
+
 log = logging.getLogger(__name__)
 
 
@@ -118,7 +120,11 @@ class AsyncCheckpointer:
 
         try:
             for ckpt_dir, kwargs in targets:
-                path = save_state(ckpt_dir, step, snapshot, **kwargs)
+                # Writer-thread span: the full background save (digest +
+                # Orbax write + rename) — what the hot path no longer
+                # pays, visible per save in the trace timeline.
+                with obs.span("ckpt_write", "ckpt", step=int(step)):
+                    path = save_state(ckpt_dir, step, snapshot, **kwargs)
                 if path is not None:  # None = refused (non-finite), no artifact
                     self._last_path = path
         except BaseException as e:  # surfaced on the next enqueue/flush
@@ -251,10 +257,11 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
 
         try:
             for ckpt_dir, kwargs in targets:
-                wrote = save_host_shard(
-                    ckpt_dir, step, host_tree, self.process_index,
-                    require_finite=kwargs.get("require_finite", True),
-                )
+                with obs.span("shard_write", "ckpt", step=int(step)):
+                    wrote = save_host_shard(
+                        ckpt_dir, step, host_tree, self.process_index,
+                        require_finite=kwargs.get("require_finite", True),
+                    )
                 if wrote:
                     with self._pending_lock:
                         self._pending.append(
@@ -292,8 +299,10 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
 
         # Snapshot + host fetch on the MAIN thread: the fetch blocks on
         # the state's producing computation (the hot-path cost of a
-        # multi-host save); an exception here enqueues nothing.
-        host_tree = host_fetch(snapshot_state(state))
+        # multi-host save); an exception here enqueues nothing.  The span
+        # is the attribution evidence for exactly that cost.
+        with obs.span("ckpt_host_fetch", "ckpt", step=int(step)):
+            host_tree = host_fetch(snapshot_state(state))
         self._seq += 1
         self._pending_step = int(step)
         self._thread = threading.Thread(
@@ -325,10 +334,11 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
             from dwt_tpu.utils.checkpoint import promote_host_shards
 
             try:
-                self._last_path = promote_host_shards(
-                    ckpt_dir, step, self.process_count,
-                    keep=kwargs.get("keep"),
-                )
+                with obs.span("ckpt_promote", "ckpt", step=int(step)):
+                    self._last_path = promote_host_shards(
+                        ckpt_dir, step, self.process_count,
+                        keep=kwargs.get("keep"),
+                    )
             except OSError as e:
                 if self._error is None:
                     self._error = e

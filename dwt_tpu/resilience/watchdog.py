@@ -84,6 +84,7 @@ class HangWatchdog:
         self._suspended = 0
         self.fired = False  # observable by injected-_exit unit tests
         self.stacks_path: Optional[str] = None
+        self.spans_path: Optional[str] = None  # flight-recorder dump
 
     # ------------------------------------------------------------------ API
 
@@ -142,8 +143,11 @@ class HangWatchdog:
                 return
 
     def _prune_dumps(self, d: str, keep: int) -> None:
-        """Cap ``stacks-*.txt`` to the newest ``keep`` (oldest mtime
-        first out) — relaunch loops must not fill the disk with dumps."""
+        """Cap ``stacks-*.txt`` files to the newest ``keep`` (oldest
+        mtime first out) — relaunch loops must not fill the disk with
+        dumps.  The flight-recorder span dumps have the same retention,
+        applied inside ``obs.flight_dump`` (every producer — watchdog
+        and guard-event paths — goes through it)."""
         try:
             dumps = [
                 os.path.join(d, name)
@@ -182,9 +186,34 @@ class HangWatchdog:
         except OSError:
             return None  # a dead ckpt mount must not stop the exit
 
+    def _flight_dump(self, stalled: float) -> Optional[str]:
+        """Flight recorder: the stacks say where every thread IS; the
+        last seconds of spans say what they had been DOING.  Dumped next
+        to the stack file, same retention cap; never blocks the exit.
+
+        The window reaches BACK PAST the stall: by the time the watchdog
+        fires, the wedged threads have recorded nothing for ``stalled``
+        seconds — a trailing window shorter than that would be empty by
+        construction, missing exactly the activity that led into the
+        hang."""
+        if not self._ckpt_dir:
+            return None
+        try:
+            from dwt_tpu.obs import FLIGHT_WINDOW_S, flight_dump
+
+            d = os.path.join(self._ckpt_dir, "watchdog")
+            return flight_dump(
+                d, reason=f"watchdog_stall {stalled:.1f}s",
+                last_s=stalled + FLIGHT_WINDOW_S,
+                keep=self.keep,  # flight_dump prunes spans-*.json itself
+            )
+        except Exception:  # noqa: BLE001 — nothing may block the exit
+            return None
+
     def _fire(self, stalled: float) -> None:
         self.fired = True
         self.stacks_path = self._dump_stacks(stalled)
+        self.spans_path = self._flight_dump(stalled)
         try:
             # Unbuffered, signal-handler-grade write: the process state is
             # unknown (that is the premise), so no logging machinery here.

@@ -29,6 +29,7 @@ no sleeps, no timing flake.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -36,7 +37,15 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dwt_tpu import obs
+
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+# Process-wide request ids: every admitted request gets one, stamped into
+# its access records AND the serving spans (``req_id`` attr), so a trace
+# timeline row and an access-log line join on it.  itertools.count.next
+# is atomic under the GIL — no lock needed across batcher instances.
+_REQ_IDS = itertools.count(1)
 
 
 class ShedError(RuntimeError):
@@ -86,6 +95,7 @@ class _Request:
     x: np.ndarray  # [n, ...sample shape]
     n: int
     enqueue_t: float
+    req_id: int = 0
     future: Future = field(default_factory=Future)
 
 
@@ -228,6 +238,15 @@ class MicroBatcher:
         with self._cond:
             return self._queued_items
 
+    @property
+    def stopping(self) -> bool:
+        """Draining or closed: ``next_batch`` returning None is final
+        (the queue is empty and admission never reopens), as opposed to
+        a mere poll timeout.  The dispatcher's heartbeat loop keys its
+        exit on this."""
+        with self._cond:
+            return self._draining or self._closed
+
     def _retry_after_ms(self) -> int:
         if self._draining:
             # Drain is permanent for THIS process: a queue-depth estimate
@@ -264,15 +283,23 @@ class MicroBatcher:
                 f"request of {n} samples exceeds the largest bucket "
                 f"{self.buckets[-1]}; split it client-side"
             )
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("batcher is closed")
-            if self._draining or self._queued_items + n > self.max_queue_items:
-                raise ShedError(self._retry_after_ms(), self._queued_items)
-            req = _Request(x=x, n=n, enqueue_t=self._clock())
-            self._queue.append(req)
-            self._queued_items += n
-            self._cond.notify_all()
+        # The admission span covers validation + the queue insert; its
+        # req_id attr is the join key against this request's access
+        # records (and the shed path's, via the raised ShedError).
+        with obs.span("admission", "serve") as sp:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
+                if (self._draining
+                        or self._queued_items + n > self.max_queue_items):
+                    raise ShedError(self._retry_after_ms(), self._queued_items)
+                req = _Request(
+                    x=x, n=n, enqueue_t=self._clock(), req_id=next(_REQ_IDS)
+                )
+                self._queue.append(req)
+                self._queued_items += n
+                self._cond.notify_all()
+            sp.add(req_id=req.req_id, n=n)
             return req.future
 
     # ------------------------------------------------------------- dispatch
@@ -308,19 +335,21 @@ class MicroBatcher:
         # Runs WITHOUT the condition lock: the concatenate+pad is the
         # batch-sized copy (tens of MB at large buckets) and holding the
         # lock through it would stall every concurrent submit().
-        real_n = sum(r.n for r in reqs)
-        bucket = bucket_for(real_n, self.buckets)
-        x = pad_to_bucket(np.concatenate([r.x for r in reqs]), bucket)
-        mask = np.zeros(bucket, bool)
-        mask[:real_n] = True
-        slices, start = [], 0
-        for r in reqs:
-            slices.append((start, start + r.n))
-            start += r.n
-        return PlannedBatch(
-            bucket=bucket, x=x, mask=mask, real_n=real_n,
-            requests=reqs, slices=slices, dispatch_t=self._clock(),
-        )
+        with obs.span("build_batch", "serve") as sp:
+            real_n = sum(r.n for r in reqs)
+            bucket = bucket_for(real_n, self.buckets)
+            x = pad_to_bucket(np.concatenate([r.x for r in reqs]), bucket)
+            mask = np.zeros(bucket, bool)
+            mask[:real_n] = True
+            slices, start = [], 0
+            for r in reqs:
+                slices.append((start, start + r.n))
+                start += r.n
+            sp.add(bucket=bucket, n=real_n)
+            return PlannedBatch(
+                bucket=bucket, x=x, mask=mask, real_n=real_n,
+                requests=reqs, slices=slices, dispatch_t=self._clock(),
+            )
 
     def next_batch(self, timeout: Optional[float] = None) -> Optional[PlannedBatch]:
         """Block until a batch is ready (or ``timeout``); ``None`` when
@@ -334,8 +363,16 @@ class MicroBatcher:
         with self._cond:
             while True:
                 if self._queue:
+                    t_plan = time.perf_counter()
                     take = self._plan_locked()
                     if take:
+                        # Only dispatching plans are recorded — the
+                        # keep-waiting wakes would flood the ring with
+                        # sub-µs spans under sustained load.
+                        obs.record_complete(
+                            "plan", "serve",
+                            time.perf_counter() - t_plan, take=take,
+                        )
                         return self._pop_locked(take)
                 elif self._closed or self._draining:
                     return None

@@ -35,6 +35,7 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from dwt_tpu import obs
 from dwt_tpu.data.loader import prefetch_to_device
 from dwt_tpu.serve.batcher import (
     DEFAULT_BUCKETS,
@@ -58,6 +59,10 @@ class _Dispatcher(threading.Thread):
     H2D staging overlapped by ``prefetch_to_device``'s producer thread.
     """
 
+    # Idle poll period for the batch wait: bounds how stale the liveness
+    # heartbeat can get on a healthy-but-idle server (see heartbeat_age).
+    POLL_S = 1.0
+
     def __init__(self, engine: ServeEngine, batcher: MicroBatcher,
                  access_log: AccessLog, staging_depth: int = 2):
         super().__init__(name="dwt-serve-dispatch", daemon=True)
@@ -66,21 +71,63 @@ class _Dispatcher(threading.Thread):
         self.access_log = access_log
         self.staging_depth = staging_depth
         self.error: Optional[BaseException] = None
+        # Liveness heartbeat: stamped at every batch-wait wake and every
+        # resolved batch.  /healthz reports its age so an external prober
+        # can tell a wedged dispatcher (age ≫ POLL_S with work queued)
+        # from an idle one — a hung device call leaves the listener
+        # perfectly responsive while serving nothing.
+        self._beat = time.monotonic()
         # Batches pulled from the batcher but not yet resolved: a batch
         # inside the staging pipeline is in NEITHER the batcher's queue
         # nor the compute loop when staging raises — its futures would
-        # be lost without this ledger.  deque append/popleft are atomic;
-        # prefetch preserves order, so popleft always matches.
+        # be lost without this ledger.  Entries are (batch, pull_time) —
+        # the oldest pull time is the liveness signal (heartbeat_age_s).
+        # deque append/popleft are atomic; prefetch preserves order, so
+        # popleft always matches.
         import collections
 
         self._inflight = collections.deque()
 
+    @property
+    def heartbeat_age_s(self) -> float:
+        # With work in flight, age is the OLDEST unresolved batch's time
+        # since pull: a dispatcher wedged inside the device call stops
+        # resolving, and this age keeps growing even though the batch-
+        # wait poll (which runs on the prefetch PRODUCER thread) keeps
+        # stamping the beat — the poll beat alone would mask exactly
+        # that hang.  Idle, it is the time since the last poll wake.
+        try:
+            _, t0 = self._inflight[0]
+        except IndexError:
+            return time.monotonic() - self._beat
+        return time.monotonic() - t0
+
+    @property
+    def in_flight_count(self) -> int:
+        """Batches staged/computing but not yet resolved."""
+        return len(self._inflight)
+
     def _planned(self):
         while True:
-            pb = self.batcher.next_batch()
+            # Bounded wait instead of a blocking one: each wake (batch
+            # or timeout) re-stamps the heartbeat, so an IDLE server's
+            # heartbeat age stays ~POLL_S while a WEDGED batch wait —
+            # impossible by construction here, but a hung engine.stage
+            # downstream is not — lets the age grow past it.
+            pb = self.batcher.next_batch(timeout=self.POLL_S)
+            self._beat = time.monotonic()
             if pb is None:
-                return
-            self._inflight.append(pb)
+                # ``stopping`` alone is not exit-worthy: a timeout-None
+                # (the poll deadline expired before the oldest request's
+                # batch delay did) can race a drain() landing with
+                # requests still queued — exiting then would strand
+                # their futures.  Drain mode plans with a zero deadline,
+                # so a non-empty queue always dispatches on the next
+                # poll; keep polling until it empties.
+                if self.batcher.stopping and self.batcher.queued_items == 0:
+                    return
+                continue
+            self._inflight.append((pb, time.monotonic()))
             yield pb
 
     def run(self) -> None:
@@ -92,7 +139,11 @@ class _Dispatcher(threading.Thread):
         clock = self.batcher.clock
 
         def stage(pb: PlannedBatch):
-            return pb, engine.stage(pb.x)
+            # Runs on the prefetch producer thread; the span is the
+            # serving H2D staging phase, bucket-attributed (the loader's
+            # generic "h2d_stage" data span wraps this whole transfer).
+            with obs.span("stage", "serve", bucket=pb.bucket):
+                return pb, engine.stage(pb.x)
 
         staged = prefetch_to_device(
             self._planned(), size=self.staging_depth, transfer=stage
@@ -101,13 +152,20 @@ class _Dispatcher(threading.Thread):
             for pb, x_dev in staged:
                 t_dev0 = time.perf_counter()
                 try:
-                    logits = np.asarray(
-                        jax.device_get(engine.forward(x_dev, pb.bucket))
-                    )
+                    # The one deliberate sync on this thread: device_get
+                    # blocks on the forward, so the span IS device time
+                    # (per bucket) — the serving twin of the two-point
+                    # bench, not a new sync added by tracing.
+                    with obs.span("device", "serve", bucket=pb.bucket,
+                                  n=pb.real_n):
+                        logits = np.asarray(
+                            jax.device_get(engine.forward(x_dev, pb.bucket))
+                        )
                 except Exception as e:  # resolve, don't strand waiters
                     for req in pb.requests:
                         self.access_log.record(
                             "error", req.n, bucket=pb.bucket,
+                            req_id=req.req_id,
                             error=f"{type(e).__name__}: {e}",
                         )
                         resolve_future(req.future, exc=e)
@@ -117,20 +175,24 @@ class _Dispatcher(threading.Thread):
                 device_ms = (t_done - t_dev0) * 1e3
                 self.batcher.note_served(pb.real_n, t_done - t_dev0)
                 now = clock()
-                for req, (lo, hi) in zip(pb.requests, pb.slices):
-                    # Record BEFORE resolving: a caller woken by the
-                    # future must find this request's record already in
-                    # the log (the bench windows on exactly that).
-                    self.access_log.record(
-                        "ok", req.n,
-                        bucket=pb.bucket, batch_n=pb.bucket,
-                        real_n=pb.real_n,
-                        queue_ms=(pb.dispatch_t - req.enqueue_t) * 1e3,
-                        device_ms=device_ms,
-                        e2e_ms=(now - req.enqueue_t) * 1e3,
-                    )
-                    resolve_future(req.future, result=logits[lo:hi])
+                with obs.span("resolve", "serve", bucket=pb.bucket,
+                              n=pb.real_n):
+                    for req, (lo, hi) in zip(pb.requests, pb.slices):
+                        # Record BEFORE resolving: a caller woken by the
+                        # future must find this request's record already
+                        # in the log (the bench windows on exactly that).
+                        self.access_log.record(
+                            "ok", req.n,
+                            bucket=pb.bucket, batch_n=pb.bucket,
+                            real_n=pb.real_n,
+                            req_id=req.req_id,
+                            queue_ms=(pb.dispatch_t - req.enqueue_t) * 1e3,
+                            device_ms=device_ms,
+                            e2e_ms=(now - req.enqueue_t) * 1e3,
+                        )
+                        resolve_future(req.future, result=logits[lo:hi])
                 self._inflight.popleft()
+                self._beat = time.monotonic()
         except BaseException as e:
             # A staging/placement failure surfaces HERE (re-raised out of
             # prefetch_to_device) — the dispatcher is dead.  Dying
@@ -154,13 +216,13 @@ class _Dispatcher(threading.Thread):
             def _fail(pb):
                 for req in pb.requests:
                     self.access_log.record(
-                        "error", req.n,
+                        "error", req.n, req_id=req.req_id,
                         error=f"dispatcher dead: {type(e).__name__}: {e}",
                     )
                     resolve_future(req.future, exc=e)
 
             while self._inflight:  # pulled into staging, never resolved
-                _fail(self._inflight.popleft())
+                _fail(self._inflight.popleft()[0])
             while True:  # still queued in the batcher
                 pb = self.batcher.next_batch(timeout=0)
                 if pb is None:
@@ -202,6 +264,7 @@ class ServeClient:
         self._dispatcher = _Dispatcher(
             engine, self.batcher, self.access_log, staging_depth
         )
+        self._t0 = time.monotonic()
         self._dispatcher.start()
 
     @property
@@ -211,6 +274,35 @@ class ServeClient:
     @property
     def dispatcher_error(self) -> Optional[BaseException]:
         return self._dispatcher.error
+
+    @property
+    def dispatcher_heartbeat_age_s(self) -> float:
+        """Liveness age: with work in flight, seconds since the OLDEST
+        unresolved batch was pulled (a hung device call makes this grow
+        without bound); idle, seconds since the last batch-wait poll
+        wake (~the poll period).  An age far past both the poll period
+        and a normal batch's device time means the dispatcher is wedged
+        — the one failure mode a listening /healthz endpoint cannot
+        otherwise see."""
+        return self._dispatcher.heartbeat_age_s
+
+    def stats(self) -> dict:
+        """The /stats body: access-log aggregates plus the live process
+        view (uptime, queue depth, in-flight batches, device memory when
+        the backend reports it)."""
+        out = self.access_log.summary()
+        out.update(
+            uptime_s=round(time.monotonic() - self._t0, 3),
+            queued_items=self.batcher.queued_items,
+            in_flight_batches=self._dispatcher.in_flight_count,
+            dispatcher_heartbeat_age_s=round(
+                self.dispatcher_heartbeat_age_s, 3
+            ),
+        )
+        mem = _device_memory_stats()
+        if mem is not None:
+            out["device_memory"] = mem
+        return out
 
     def submit(self, x: np.ndarray) -> Future:
         try:
@@ -234,6 +326,20 @@ class ServeClient:
         self._dispatcher.join(timeout)
         if self._dispatcher.is_alive():
             raise RuntimeError("serving dispatcher did not drain in time")
+
+
+def _device_memory_stats() -> Optional[dict]:
+    """Device 0's allocator stats (bytes in use / limit / peak) where the
+    backend exposes them (TPU/GPU do; CPU returns None).  Never raises —
+    /stats must answer whatever the backend's mood."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
 
 
 # ------------------------------------------------------------- HTTP front
@@ -271,12 +377,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "draining": bool(self.draining.is_set()),
                 "buckets": list(self.client.engine.buckets),
                 "queued_items": self.client.batcher.queued_items,
+                # Wedged-but-listening detection: a prober that sees this
+                # age far past the dispatcher poll period (~1 s) while
+                # queued_items > 0 should recycle the process even though
+                # the thread is technically alive (hung device call).
+                "dispatcher_heartbeat_age_s": round(
+                    self.client.dispatcher_heartbeat_age_s, 3
+                ),
                 "step": self.client.engine.step,
                 **({"dispatcher_error": f"{type(err).__name__}: {err}"}
                    if err is not None else {}),
             })
         elif self.path == "/stats":
-            self._reply(200, self.client.access_log.summary())
+            self._reply(200, self.client.stats())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -438,12 +551,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8978)
     p.add_argument("--access_log", default=None,
                    help="JSONL access-record file (schema: serve/metrics.py)")
+    p.add_argument("--obs_trace", default=None,
+                   help="span tracing: write a Chrome trace-event JSON of "
+                        "the serving path's spans (admission → plan → "
+                        "build_batch → stage → device → resolve, req_id-"
+                        "correlated with access records) to this path at "
+                        "drain; DWT_OBS_TRACE env is the flagless form")
     return p
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
+    obs.maybe_enable(args.obs_trace)
     engine = build_engine(args)
     access_log = AccessLog(args.access_log)
     client = ServeClient(
@@ -505,6 +625,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     summary = access_log.summary()
     print(json.dumps(summary), flush=True)
     access_log.close()
+    obs.export()  # flush the serving trace inside the grace window
     return 0
 
 

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dwt_tpu import obs
 from dwt_tpu.data.loader import (
     QUARANTINED,
     _load_item,
@@ -274,7 +275,11 @@ class EvalPipeline:
         counters = self._place(eval_counters())
         # The pass's whitening matrices, factorized ONCE from the frozen
         # running stats (site-stacked) and replicated like the stats.
-        cache = self._place(self._cache_fn(state.batch_stats))
+        # The span measures the build's dispatch+placement enqueue (the
+        # tracer never syncs); its device cost lands in the first
+        # eval_dispatch that consumes it.
+        with obs.span("whiten_cache_build", "eval"):
+            cache = self._place(self._cache_fn(state.batch_stats))
         batches = prefetch_to_device(
             (stack_eval_chunk(g) for g in _chunk_groups(stream, self.eval_k)),
             size=self.prefetch_size,
@@ -284,10 +289,12 @@ class EvalPipeline:
         first = True
         try:
             t_prev = time.perf_counter()
-            for chunk in batches:
-                counters = self._eval_fn(
-                    counters, state.params, state.batch_stats, cache, chunk
-                )
+            for chunk in obs.traced_iter(batches, "eval_batch_wait", "eval"):
+                with obs.span("eval_dispatch", "eval"):
+                    counters = self._eval_fn(
+                        counters, state.params, state.batch_stats, cache,
+                        chunk,
+                    )
                 t_now = time.perf_counter()
                 if first:
                     # The first dispatch of a run pays the jit
@@ -299,7 +306,8 @@ class EvalPipeline:
                 t_prev = t_now
         finally:
             batches.close()
-        vals = _fetch(counters)  # the pass's ONE device→host sync
+        with obs.span("eval_host_fetch", "eval"):
+            vals = _fetch(counters)  # the pass's ONE device→host sync
         self.last_host_fetches += 1
         loss_sum = float(vals["loss_sum"])
         correct = int(vals["correct"])
@@ -409,14 +417,18 @@ class EvalPipeline:
                 chunks, size=self.prefetch_size, transfer=self._transfer
             )
             try:
-                for xs in batches:
-                    state = self._collect_sharded(state, xs)
+                for xs in obs.traced_iter(
+                    batches, "collect_batch_wait", "eval"
+                ):
+                    with obs.span("collect_dispatch", "eval"):
+                        state = self._collect_sharded(state, xs)
             finally:
                 batches.close()
             if usable < n:
                 tail = self._load_tail(dataset, usable, n, seed, epoch)
                 if tail is not None:
-                    state = self._collect_tail(state, self._place(tail))
+                    with obs.span("collect_dispatch", "eval"):
+                        state = self._collect_tail(state, self._place(tail))
             return state
         # Unsharded (or tiny-dataset) pipeline: still scanned, prefetched,
         # device-resident; the ragged tail cuts into its own dispatch.
@@ -432,8 +444,9 @@ class EvalPipeline:
             chunks, size=self.prefetch_size, transfer=self._place,
         )
         try:
-            for xs in batches:
-                state = self._collect_scanned(state, xs)
+            for xs in obs.traced_iter(batches, "collect_batch_wait", "eval"):
+                with obs.span("collect_dispatch", "eval"):
+                    state = self._collect_scanned(state, xs)
         finally:
             batches.close()
         return state

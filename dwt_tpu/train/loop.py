@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dwt_tpu import obs
 from dwt_tpu.config import DigitsConfig, OfficeHomeConfig
 from dwt_tpu.data import (
     ArrayDataset,
@@ -82,6 +83,7 @@ from dwt_tpu.train.steps import (
     stack_batches,
 )
 from dwt_tpu.utils import (
+    HeartbeatEmitter,
     MetricLogger,
     anchor_dir,
     is_valid_checkpoint,
@@ -338,12 +340,13 @@ def _run_chunks(state, chunks, raw_step, make_chunked, fns, on_steps):
     ``on_steps`` may return ``(state, stop)`` to substitute the state the
     next chunk continues from (divergence-guard ``skip_step`` recovery /
     fault injection) and to request a clean early exit (preemption)."""
-    for chunk in chunks:
+    for chunk in obs.traced_iter(chunks, "batch_wait"):
         n = _chunk_len(chunk)
         fn = fns.get(n)
         if fn is None:
             fn = fns[n] = make_chunked(raw_step, n)
-        state, ms = fn(state, chunk)
+        with obs.span("step_dispatch", n=n):
+            state, ms = fn(state, chunk)
         out = on_steps(state, n, ms)
         if out is not None:
             state, stop = out
@@ -420,7 +423,8 @@ class _StepBoundary:
     """
 
     def __init__(self, guard, preempt, coord, watchdog, logger=None,
-                 ckpt=None, notice_watcher=None):
+                 ckpt=None, notice_watcher=None, heartbeat=None,
+                 flight_dir=None):
         self.guard = guard
         self.preempt = preempt
         self.coord = coord
@@ -428,11 +432,29 @@ class _StepBoundary:
         self.logger = logger
         self.ckpt = ckpt
         self.notice_watcher = notice_watcher
+        # Periodic "heartbeat" record (utils.metrics.HeartbeatEmitter):
+        # the always-on liveness signal when span tracing is off.
+        self.heartbeat = heartbeat
+        # Flight-recorder target (ckpt_dir/watchdog, beside the stack
+        # dumps): a guard event dumps the last seconds of spans BEFORE
+        # the recovery/halt path runs, capturing what led up to it.
+        self.flight_dir = flight_dir
         self.on_notice = None  # loop-installed: state -> saved step or None
         self.notice_step: Optional[int] = None  # proactive-save step
         self._notice_handled = False
         self.stop = False
         self._decides_logged = 0
+
+    def _flight(self, reason: str) -> None:
+        if self.flight_dir:
+            # Honor the run's --watchdog_keep for guard-event dumps too
+            # — one retention cap for the whole directory.  Without a
+            # watchdog, flight_dump's own default keep applies.
+            keep = getattr(self.watchdog, "keep", None)
+            if keep is not None:
+                obs.flight_dump(self.flight_dir, reason, keep=keep)
+            else:
+                obs.flight_dump(self.flight_dir, reason)
 
     def _local_notice(self) -> bool:
         return (
@@ -474,7 +496,13 @@ class _StepBoundary:
         )
 
     def __call__(self, state, metrics, n_steps: int, gstep: int):
+        with obs.span("boundary"):
+            return self._run(state, metrics, n_steps, gstep)
+
+    def _run(self, state, metrics, n_steps: int, gstep: int):
         self.watchdog.heartbeat()
+        if self.heartbeat is not None:
+            self.heartbeat.step(gstep)
         # Control faults fire between the heartbeat and the guard so an
         # injected hang is measured from a fresh beat and an injected
         # SIGTERM is visible to this very boundary's stop flag.
@@ -484,7 +512,8 @@ class _StepBoundary:
         if self.guard is not None:
             recoveries_before = self.guard.recoveries
             try:
-                state = self.guard.step(state, metrics, n_steps, gstep)
+                with obs.span("guard_check", "detail"):
+                    state = self.guard.step(state, metrics, n_steps, gstep)
                 if self.guard.recoveries != recoveries_before:
                     # lr_backoff/skip_step fired: no exception, but the
                     # other hosts must take the same rung.
@@ -493,18 +522,25 @@ class _StepBoundary:
                 event, code = e, EVENT_ROLLBACK
             except DivergenceError as e:
                 event, code = e, EVENT_HALT
+        if event is not None or code == EVENT_RECOVERED:
+            # Flight recorder: a guard event's post-mortem wants the last
+            # seconds of spans — what every thread had been DOING —
+            # dumped before any recovery path mutates the run's state.
+            self._flight(f"guard_event_step{gstep}")
         if self.coord.enabled:
-            decision = self.coord.decide(
-                stop=self.preempt.should_stop,
-                event=code,
-                rollback_step=(
-                    event.step if isinstance(event, RollbackRequest) else -1
-                ),
-                save_done_seq=(
-                    self.ckpt.done_seq() if self.ckpt is not None else -1
-                ),
-                notice=self._local_notice(),
-            )
+            with obs.span("consensus_decide", "detail"):
+                decision = self.coord.decide(
+                    stop=self.preempt.should_stop,
+                    event=code,
+                    rollback_step=(
+                        event.step if isinstance(event, RollbackRequest)
+                        else -1
+                    ),
+                    save_done_seq=(
+                        self.ckpt.done_seq() if self.ckpt is not None else -1
+                    ),
+                    notice=self._local_notice(),
+                )
             self._log_consensus(gstep)
             self.stop = self.stop or decision.stop
             if self.ckpt is not None:
@@ -531,6 +567,7 @@ class _StepBoundary:
                 # preceded the collective, e.g. a host-local data NaN, or
                 # its ladder escalated further): mirror the remote rung so
                 # the replicated state stays identical on every process.
+                self._flight(f"remote_guard_event_step{gstep}")
                 if decision.event == EVENT_ROLLBACK and self.guard is not None:
                     # Keep the rollback budget and the re-seed stride in
                     # lockstep with the host that fired: every process
@@ -607,12 +644,19 @@ class _CkptPipeline:
     def save_multi(self, targets, step: int, state) -> None:
         """``targets = [(dir, kwargs), ...]`` written from ONE snapshot in
         one writer task — a coinciding boundary (periodic + anchor) costs
-        one enqueue, not a blocking backpressure join per directory."""
-        if self._acp is not None:
-            self._acp.save_multi(targets, step, state)
-        else:
-            for ckpt_dir, kwargs in targets:
-                save_state(ckpt_dir, step, state, **kwargs)
+        one enqueue, not a blocking backpressure join per directory.
+
+        The ``ckpt_enqueue`` span is the hot path's whole checkpoint
+        cost on the async path (snapshot dispatch + enqueue, plus any
+        backpressure join); on the sync path it books the full blocking
+        ``save_state`` — the attribution report shows exactly which one
+        a run paid."""
+        with obs.span("ckpt_enqueue", step=int(step)):
+            if self._acp is not None:
+                self._acp.save_multi(targets, step, state)
+            else:
+                for ckpt_dir, kwargs in targets:
+                    save_state(ckpt_dir, step, state, **kwargs)
 
     def save_sync(self, ckpt_dir: str, step: int, state, **kwargs):
         """Join any in-flight save, then save on THIS thread and return
@@ -621,8 +665,16 @@ class _CkptPipeline:
         a follow-up action (the best-record update): the async writer
         deliberately swallows a refusal (it is not an error), so a caller
         that must know cannot go through the queue."""
-        self.flush()
-        return save_state(ckpt_dir, step, state, **kwargs)
+        with obs.span("ckpt_sync_save", step=int(step)):
+            self.flush()
+            return save_state(ckpt_dir, step, state, **kwargs)
+
+    def in_flight_depth(self) -> int:
+        """0/1: is an async save currently in the writer (single
+        in-flight by contract)?  The heartbeat record's ckpt depth."""
+        return int(
+            self._acp is not None and self._acp.in_flight is not None
+        )
 
     def done_seq(self) -> int:
         """This host's newest fully-written async save sequence (-1 when
@@ -648,9 +700,10 @@ class _CkptPipeline:
         acp = self._acp
         if not isinstance(acp, MultiHostAsyncCheckpointer) or self._coord is None:
             return
-        agreed = self._coord.agree_step(acp.done_seq)
-        acp.promote_up_to(agreed)
-        self._coord.agree_step(agreed)  # barrier: promotion now visible
+        with obs.span("ckpt_barrier", "ckpt"):
+            agreed = self._coord.agree_step(acp.done_seq)
+            acp.promote_up_to(agreed)
+            self._coord.agree_step(agreed)  # barrier: promotion now visible
         if raise_errors:
             acp.flush()  # surface any promotion failure at the rendezvous
 
@@ -827,6 +880,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     """Train LeNet-DWT; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    obs.maybe_enable(getattr(cfg, "obs_trace", None))
     _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
     if cfg.group_size == 32:
@@ -950,7 +1004,15 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         # original exception.  Normal paths flush explicitly first.
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
         boundary = _StepBoundary(
-            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw
+            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw,
+            heartbeat=HeartbeatEmitter(
+                logger, getattr(cfg, "heartbeat_every", 0),
+                ckpt.in_flight_depth,
+            ),
+            flight_dir=(
+                os.path.join(cfg.ckpt_dir, "watchdog") if cfg.ckpt_dir
+                else None
+            ),
         )
 
         def _proactive_save(st):
@@ -998,18 +1060,28 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                     batches = prefetch_to_device(
                         epoch_batches(), size=2, transfer=wrap_batch
                     )
-                    for i, batch in enumerate(batches):
-                        state, metrics = train_step(state, batch)
+                    # Span phases (dwt_tpu.obs, near-free when off):
+                    # batch_wait = wait on the prefetch/staging pipeline;
+                    # step_dispatch = enqueue of the compiled step (NOT
+                    # device time — spans never sync); metric_host_fetch
+                    # = the float() materialization the train record
+                    # forces; boundary = guard/consensus/injection.
+                    for i, batch in enumerate(
+                        obs.traced_iter(batches, "batch_wait")
+                    ):
+                        with obs.span("step_dispatch"):
+                            state, metrics = train_step(state, batch)
                         gstep += 1
                         state, metrics = inject.maybe_nan(state, metrics, gstep)
                         if i % cfg.log_interval == 0:
-                            logger.log(
-                                "train",
-                                int(state.step),
-                                epoch=epoch,
-                                cls_loss=metrics["cls_loss"],
-                                entropy_loss=metrics["entropy_loss"],
-                            )
+                            with obs.span("metric_host_fetch"):
+                                logger.log(
+                                    "train",
+                                    int(state.step),
+                                    epoch=epoch,
+                                    cls_loss=metrics["cls_loss"],
+                                    entropy_loss=metrics["entropy_loss"],
+                                )
                         state, stop = boundary(state, metrics, 1, gstep)
                         if stop:
                             break
@@ -1030,16 +1102,17 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         lo = gstep + 1
                         gstep += n
                         st, ms = inject.maybe_nan(st, ms, lo, gstep)
-                        for j in range(pos, pos + n):
-                            if j % cfg.log_interval == 0:
-                                jj = j - pos
-                                logger.log(
-                                    "train",
-                                    step0 + j + 1,
-                                    epoch=epoch,
-                                    cls_loss=ms["cls_loss"][jj],
-                                    entropy_loss=ms["entropy_loss"][jj],
-                                )
+                        with obs.span("metric_host_fetch"):
+                            for j in range(pos, pos + n):
+                                if j % cfg.log_interval == 0:
+                                    jj = j - pos
+                                    logger.log(
+                                        "train",
+                                        step0 + j + 1,
+                                        epoch=epoch,
+                                        cls_loss=ms["cls_loss"][jj],
+                                        entropy_loss=ms["entropy_loss"][jj],
+                                    )
                         pos += n
                         return boundary(st, ms, n, gstep)
 
@@ -1134,8 +1207,12 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         if resume_step is not None else {}
                     ),
                 )
+                # Spans must survive the exit: flush the trace before the
+                # grace window closes (no-op when tracing is off).
+                obs.export()
                 return acc
-            result = evalp.evaluate(state, target_test_ds)
+            with obs.span("eval_pass", imgs=len(target_test_ds)):
+                result = evalp.evaluate(state, target_test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), epoch=epoch, **result)
@@ -1164,6 +1241,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         with wd.suspended():
             ckpt.flush()
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
+    obs.export()  # normal-exit trace flush (no-op when tracing is off)
     return acc
 
 
@@ -1231,6 +1309,7 @@ def run_officehome(
     """Train ResNet-DWT with MEC; returns final target test accuracy (%)."""
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
+    obs.maybe_enable(getattr(cfg, "obs_trace", None))
     _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
 
@@ -1347,7 +1426,8 @@ def run_officehome(
         # these indices so the cadences match the per-step loop.
         nonlocal acc, best_acc, state
         if (it + 1) % cfg.check_acc_step == 0:
-            result = evalp.evaluate(state, test_ds)
+            with obs.span("eval_pass", imgs=len(test_ds)):
+                result = evalp.evaluate(state, test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
             logger.log("test", int(state.step), iter=it, **result)
@@ -1407,7 +1487,15 @@ def run_officehome(
         # Abnormal-exit rendezvous for the async writer (see run_digits).
         _cleanup.callback(lambda: ckpt.close(raise_errors=False))
         boundary = _StepBoundary(
-            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw
+            guard, preempt, coord, wd, logger, ckpt=ckpt, notice_watcher=nw,
+            heartbeat=HeartbeatEmitter(
+                logger, getattr(cfg, "heartbeat_every", 0),
+                ckpt.in_flight_depth,
+            ),
+            flight_dir=(
+                os.path.join(cfg.ckpt_dir, "watchdog") if cfg.ckpt_dir
+                else None
+            ),
         )
 
         def _proactive_save(st):
@@ -1467,16 +1555,22 @@ def run_officehome(
                     batches = prefetch_to_device(
                         train_batches(), size=2, transfer=wrap_batch
                     )
-                    for it, batch in enumerate(batches, start=start_iter):
-                        state, metrics = train_step(state, batch)
+                    # Span phases: see run_digits' per-step loop.
+                    for it, batch in enumerate(
+                        obs.traced_iter(batches, "batch_wait"),
+                        start=start_iter,
+                    ):
+                        with obs.span("step_dispatch"):
+                            state, metrics = train_step(state, batch)
                         state, metrics = inject.maybe_nan(
                             state, metrics, step0 + it + 1
                         )
                         if it % cfg.log_interval == 0:
-                            _log_train(
-                                it, step0 + it + 1,
-                                metrics["cls_loss"], metrics["mec_loss"],
-                            )
+                            with obs.span("metric_host_fetch"):
+                                _log_train(
+                                    it, step0 + it + 1,
+                                    metrics["cls_loss"], metrics["mec_loss"],
+                                )
                         state, stop = boundary(
                             state, metrics, 1, step0 + it + 1
                         )
@@ -1501,14 +1595,15 @@ def run_officehome(
                         state, ms = inject.maybe_nan(
                             st, ms, step0 + it + 1, step0 + it + n
                         )
-                        for j in range(n):
-                            if (it + j) % cfg.log_interval == 0:
-                                _log_train(
-                                    it + j,
-                                    step0 + it + j + 1,
-                                    ms["cls_loss"][j],
-                                    ms["mec_loss"][j],
-                                )
+                        with obs.span("metric_host_fetch"):
+                            for j in range(n):
+                                if (it + j) % cfg.log_interval == 0:
+                                    _log_train(
+                                        it + j,
+                                        step0 + it + j + 1,
+                                        ms["cls_loss"][j],
+                                        ms["mec_loss"][j],
+                                    )
                         it += n
                         state, stop = boundary(state, ms, n, step0 + it)
                         # _boundary_actions evaluates/saves the live state
@@ -1592,6 +1687,8 @@ def run_officehome(
                     if resume_step is not None else {}
                 ),
             )
+            # Flush spans inside the grace window (no-op when off).
+            obs.export()
             return acc
         # Training done: surface any in-flight writer failure before the
         # stat-collection protocol spends more device time.  Masked: the
@@ -1633,14 +1730,17 @@ def run_officehome(
         with logger.timed(
             "stat_collection", int(state.step), pass_index=p,
             imgs=len(test_ds),
-        ):
+        ), obs.span("stat_collection", pass_index=p):
             state = evalp.collect_stats(
                 state, test_ds, seed=cfg.seed, epoch=p
             )
             # The pass dispatches asynchronously; settle before stamping
             # the wall time so the record measures work, not enqueueing.
+            # (This sync predates the tracer and is the phase's OWN
+            # rendezvous — the span merely observes it.)
             jax.block_until_ready(jax.tree.leaves(state.batch_stats))
-    result = evalp.evaluate(state, test_ds)
+    with obs.span("eval_pass", imgs=len(test_ds)):
+        result = evalp.evaluate(state, test_ds)
     acc = result["accuracy"]
     logger.log("final_test", int(state.step), **result)
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
@@ -1649,4 +1749,5 @@ def run_officehome(
         # (effectively synchronous — nothing overlaps a final save).
         ckpt.save(cfg.ckpt_dir, int(state.step), state, **_keep_kwargs(cfg))
         ckpt.flush()
+    obs.export()  # normal-exit trace flush (no-op when tracing is off)
     return acc

@@ -1,7 +1,9 @@
 """dwt_tpu.utils — metrics logging, checkpoints, repro verdicts."""
 
 from dwt_tpu.utils.metrics import (
+    HeartbeatEmitter,
     MetricLogger,
+    host_rss_mb,
     percentile,
     percentile_summary,
 )
@@ -24,7 +26,9 @@ from dwt_tpu.utils.repro import (
 )
 
 __all__ = [
+    "HeartbeatEmitter",
     "MetricLogger",
+    "host_rss_mb",
     "percentile",
     "percentile_summary",
     "anchor_dir",

@@ -9,10 +9,11 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import sys
 import time
-from typing import IO, Iterable, Optional, Sequence
+from typing import IO, Callable, Iterable, Optional, Sequence
 
 
 def percentile(values: Sequence[float], q: float) -> float:
@@ -37,8 +38,6 @@ def _nearest_rank(sorted_vals: Sequence[float], q: float) -> float:
         raise ValueError("percentile of empty sequence")
     if q == 0.0:
         return sorted_vals[0]
-    import math
-
     # Nearest-rank: ceil(q/100 * N), 1-indexed.  The epsilon absorbs float
     # dust like 0.29*100 -> 28.999... so exact-boundary ranks stay exact.
     rank = math.ceil(q * len(sorted_vals) / 100.0 - 1e-9)
@@ -69,17 +68,47 @@ def percentile_summary(
 
 
 class MetricLogger:
-    def __init__(self, jsonl_path: Optional[str] = None, stream: IO = sys.stdout):
+    """Structured record sink: stdout line + optional JSONL file.
+
+    JSONL writes are BUFFERED (``flush_every_n`` records or
+    ``flush_interval_s`` seconds, whichever first): a ``flush()`` +
+    implicit disk round-trip per record was a measurable hot-path tax at
+    ``--log_interval 1`` cadences.  Durability semantics are preserved
+    where they matter: ``sync=True`` records (crash/preempt/rollback
+    narration) flush AND fsync immediately, and ``close()`` flushes —
+    only an abnormal hard kill (SIGKILL, watchdog ``os._exit``) can lose
+    the trailing unsynced records, which is exactly the window the
+    flight recorder and ``sync=True`` kinds exist to cover.
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None, stream: IO = sys.stdout,
+                 flush_every_n: int = 20, flush_interval_s: float = 2.0):
         self.stream = stream
         self._file = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.time()
+        self._flush_every_n = max(1, int(flush_every_n))
+        self._flush_interval_s = float(flush_interval_s)
+        self._unflushed = 0
+        self._last_flush = time.monotonic()
 
-    def log(self, kind: str, step: int, sync: bool = False, **values: float) -> None:
-        """Emit one record.  ``sync=True`` fsyncs the JSONL file: records
-        that narrate a crash/preemption/rollback (the resilience layer's
-        ``preempt``/``divergence``/``rollback`` kinds) must survive the
-        process dying immediately after — an OS-buffered line would vanish
-        with exactly the evidence a post-mortem needs."""
+    def _flush_file(self, sync: bool = False) -> None:
+        self._file.flush()
+        if sync:
+            os.fsync(self._file.fileno())
+        self._unflushed = 0
+        self._last_flush = time.monotonic()
+
+    def log(self, kind: str, step: int, sync: bool = False,
+            flush: bool = False, **values: float) -> None:
+        """Emit one record.  ``sync=True`` flushes and fsyncs the JSONL
+        file: records that narrate a crash/preemption/rollback (the
+        resilience layer's ``preempt``/``divergence``/``rollback`` kinds)
+        must survive the process dying immediately after — an OS-buffered
+        line would vanish with exactly the evidence a post-mortem needs.
+        ``flush=True`` flushes without the fsync — for liveness records
+        (heartbeats) that must be READABLE immediately (a hang means no
+        later log() ever runs the cadence flush) but need not survive an
+        OS crash."""
         record = {
             "kind": kind,
             "step": int(step),
@@ -98,9 +127,15 @@ class MetricLogger:
         print(f"[{kind}] {pretty}", file=self.stream, flush=True)
         if self._file:
             self._file.write(json.dumps(record) + "\n")
-            self._file.flush()
+            self._unflushed += 1
             if sync:
-                os.fsync(self._file.fileno())
+                self._flush_file(sync=True)
+            elif (
+                flush
+                or self._unflushed >= self._flush_every_n
+                or time.monotonic() - self._last_flush >= self._flush_interval_s
+            ):
+                self._flush_file()
 
     @contextlib.contextmanager
     def timed(self, kind: str, step: int, **values):
@@ -110,19 +145,95 @@ class MetricLogger:
         anything without a natural per-item record): callers that need a
         rate pair the emitted ``seconds`` with a count field (e.g.
         ``imgs=...``).  The record is emitted on exit even when the block
-        raises — a phase that died half-way is exactly when its elapsed
-        time matters for the post-mortem.
+        raises — stamped ``error: true`` then, so post-mortem records are
+        distinguishable from a phase that merely finished slow.
         """
         t0 = time.perf_counter()
         try:
             yield
-        finally:
+        except BaseException:
+            self.log(
+                kind, step,
+                seconds=round(time.perf_counter() - t0, 3),
+                error=True,
+                **values,
+            )
+            raise
+        else:
             self.log(
                 kind, step,
                 seconds=round(time.perf_counter() - t0, 3),
                 **values,
             )
 
+    def flush(self) -> None:
+        if self._file:
+            self._flush_file()
+
     def close(self) -> None:
         if self._file:
+            self._flush_file()
             self._file.close()
+
+
+def host_rss_mb() -> float:
+    """Current resident set size in MB (``/proc/self/statm``; falls back
+    to the peak-RSS rusage counter where /proc is unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        # ru_maxrss is KiB on Linux (bytes on macOS); either way this is
+        # the PEAK, good enough for a fallback signal.
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e3
+
+
+class HeartbeatEmitter:
+    """Periodic cheap liveness record for the training loops.
+
+    Every ``every`` steps emits a ``heartbeat`` record with a steps/s
+    EWMA, the host RSS, and the async-checkpoint in-flight depth — the
+    always-on signal an operator (or ``tools/obs_report.py``) reads when
+    full span tracing is off.  ``every <= 0`` disables; the per-step
+    cost is then one int compare.
+    """
+
+    def __init__(self, logger: "MetricLogger", every: int,
+                 in_flight_fn: Optional[Callable[[], int]] = None):
+        self.every = int(every or 0)
+        self._logger = logger
+        self._in_flight = in_flight_fn
+        self._last_step: Optional[int] = None
+        self._last_t = 0.0
+        self._rate: Optional[float] = None
+
+    def step(self, gstep: int) -> None:
+        if self.every <= 0:
+            return
+        if self._last_step is None:
+            self._last_step, self._last_t = gstep, time.monotonic()
+            return
+        if gstep - self._last_step < self.every:
+            return
+        now = time.monotonic()
+        rate = (gstep - self._last_step) / max(now - self._last_t, 1e-9)
+        # EWMA over emission windows: smooth enough to read, fresh
+        # enough that a slowdown shows within a couple of heartbeats.
+        self._rate = rate if self._rate is None else (
+            0.7 * self._rate + 0.3 * rate
+        )
+        self._last_step, self._last_t = gstep, now
+        values = {
+            "steps_per_s": round(self._rate, 3),
+            "rss_mb": round(host_rss_mb(), 1),
+        }
+        if self._in_flight is not None:
+            values["ckpt_in_flight"] = int(self._in_flight())
+        # flush (no fsync): the heartbeat is the liveness signal an
+        # operator greps DURING a hang — buffered, the newest one would
+        # sit in userspace through exactly that window (no later log()
+        # runs the cadence flush, and a watchdog os._exit skips close()).
+        self._logger.log("heartbeat", gstep, flush=True, **values)

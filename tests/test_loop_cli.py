@@ -27,6 +27,10 @@ def test_checkpoint_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~44 s — CLI resume is proven in tier-1 by the chaos
+# smoke (resume-from-proactive-save) and the newest-valid/anchor restore
+# walk by test_resilience; the obs traced-run fixture keeps an
+# end-to-end digits CLI run in tier-1.
 def test_digits_cli_synthetic_with_resume(tmp_path):
     from dwt_tpu.cli.usps_mnist import main
 

@@ -1,0 +1,711 @@
+"""Observability layer: span tracer, trace export, flight recorder,
+attribution report, metric-logger buffering, heartbeats.
+
+Contracts pinned here:
+
+* exported traces ARE Chrome trace-event JSON (required keys, numeric
+  non-negative ts/dur, int pid/tid) — Perfetto/TensorBoard loadable;
+* the tracer NEVER syncs the device (counting shim on
+  ``jax.block_until_ready`` + a source scan of ``dwt_tpu/obs``);
+* a disabled span costs ~nothing (no-op fast path, sub-10 µs);
+* the flight recorder dumps the trailing span window on a watchdog
+  stall (in-process fired watchdog; subprocess chaos-hang case slow)
+  and on a divergence-guard event;
+* ``tools/obs_report.py`` over a traced digits CLI run produces a
+  per-step breakdown whose phases + explicit unattributed residual
+  account for 100% of the loop wall time;
+* ``MetricLogger`` buffers JSONL writes but keeps ``sync=True``
+  durability; ``timed()`` stamps ``error: true`` on raising blocks;
+  ``HeartbeatEmitter`` emits on its cadence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dwt_tpu import obs
+from dwt_tpu.utils.metrics import HeartbeatEmitter, MetricLogger, host_rss_mb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with tracing disabled — the tracer is
+    process-global and must not leak across tests."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# ----------------------------------------------------------- tracer core
+
+
+def test_disabled_span_is_shared_noop_and_cheap():
+    assert not obs.enabled()
+    s = obs.span("anything")
+    assert s is obs.NULL_SPAN
+    assert s.add(k=1) is s  # attrs on the null span are dropped, not errors
+    items = [1, 2, 3]
+    assert obs.traced_iter(items, "w") is items  # unchanged, zero frames
+    obs.record_complete("x", "step", 0.5)  # no-op, no error
+    assert obs.snapshot() == []
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("s"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    # "near-zero cost when disabled": sub-µs measured; 10 µs bounds it
+    # robustly against CI contention while still catching an accidental
+    # allocation/lock on the fast path.
+    assert per_call < 10e-6, f"disabled span cost {per_call * 1e6:.2f} µs"
+
+
+def test_tracer_records_spans_across_threads():
+    obs.configure(path=None)
+    with obs.span("main_phase", "step", step=3):
+        time.sleep(0.002)
+
+    def worker():
+        with obs.span("writer_phase", "ckpt"):
+            time.sleep(0.002)
+
+    t = threading.Thread(target=worker, name="writer-0")
+    t.start()
+    t.join()
+    recs = obs.snapshot()
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["main_phase"]["cat"] == "step"
+    assert by_name["main_phase"]["attrs"] == {"step": 3}
+    assert by_name["main_phase"]["dur"] >= 0.002
+    assert by_name["writer_phase"]["thread"] == "writer-0"
+    assert by_name["writer_phase"]["tid"] != by_name["main_phase"]["tid"]
+    assert recs == sorted(recs, key=lambda r: r["ts"])
+
+
+def test_ring_wraps_fixed_size_and_counts_drops():
+    tracer = obs.Tracer(capacity=16)
+    for i in range(50):
+        tracer.record_complete("s", "step", 1e-6, attrs={"i": i})
+    recs = tracer.snapshot()
+    assert len(recs) == 16  # fixed-size: wrapped, never grew
+    assert [r["attrs"]["i"] for r in recs] == list(range(34, 50))  # newest
+    assert tracer.dropped_spans() == 34
+
+
+def test_ring_grows_on_demand_then_wraps():
+    """A fresh ring starts at the small initial allocation (threads that
+    record a handful of spans never pay for a full ring), grows ×4 as
+    writes arrive, and wraps once at the tracer capacity."""
+    from dwt_tpu.obs import spans as spans_mod
+
+    tracer = obs.Tracer(capacity=1024)
+    tracer.record_complete("s", "step", 1e-6, attrs={"i": 0})
+    ring = tracer._ring()
+    assert ring.cap == spans_mod.INIT_CAPACITY
+    for i in range(1, 2000):
+        tracer.record_complete("s", "step", 1e-6, attrs={"i": i})
+    assert ring.cap == 1024  # grew to the cap, then wrapped
+    recs = tracer.snapshot()
+    assert [r["attrs"]["i"] for r in recs] == list(range(976, 2000))
+    assert tracer.dropped_spans() == 976
+
+
+def test_dead_thread_rings_recycled_past_pool_cap(monkeypatch):
+    """Per-request thread churn (a traced HTTP server) must not grow
+    memory without bound: past the ring pool cap, dead threads' rings
+    are recycled for new threads instead of allocated."""
+    from dwt_tpu.obs import spans as spans_mod
+
+    monkeypatch.setattr(spans_mod, "RING_POOL_MAX", 8)
+    tracer = obs.Tracer(capacity=64)
+
+    def worker(k):
+        tracer.record_complete("req", "serve", 1e-6, attrs={"k": k})
+
+    for k in range(20):
+        t = threading.Thread(target=worker, args=(k,), name=f"h-{k}")
+        t.start()
+        t.join()
+    assert len(tracer._rings) <= 8
+    # The latest thread's span survived; recycled rings dropped theirs.
+    ks = {r["attrs"]["k"] for r in tracer.snapshot()}
+    assert 19 in ks and len(ks) <= 8
+
+
+def test_snapshot_trailing_window_filters_old_spans():
+    obs.configure(path=None)
+    tracer = obs.get_tracer()
+    now = time.perf_counter()
+    tracer.record_complete("old", "step", 0.001, end=now - 60.0)
+    tracer.record_complete("fresh", "step", 0.001, end=now)
+    names = [r["name"] for r in obs.snapshot(last_s=5.0)]
+    assert names == ["fresh"]
+    assert {r["name"] for r in obs.snapshot()} == {"old", "fresh"}
+
+
+def test_maybe_enable_env_gate(monkeypatch, tmp_path):
+    monkeypatch.setenv(obs.spans.ENV_TRACE, "0")
+    assert not obs.maybe_enable(None) and not obs.enabled()
+    monkeypatch.setenv(obs.spans.ENV_TRACE, "1")
+    assert obs.maybe_enable(None) and obs.enabled()
+    assert obs.export_path() is None  # "1" = tracing without a target
+    obs.disable()
+    p = str(tmp_path / "t.json")
+    monkeypatch.setenv(obs.spans.ENV_TRACE, p)
+    assert obs.maybe_enable(None)
+    assert obs.export_path() == p
+    obs.disable()
+    monkeypatch.delenv(obs.spans.ENV_TRACE)
+    assert obs.maybe_enable(str(tmp_path / "f.json"))  # flag wins alone
+    assert obs.export_path() == str(tmp_path / "f.json")
+
+
+# -------------------------------------------------------- export contract
+
+
+def _sample_trace(tmp_path):
+    obs.configure(path=str(tmp_path / "trace.json"))
+    with obs.span("phase_a", "step", step=1):
+        time.sleep(0.001)
+    with obs.span("phase_b", "eval"):
+        pass
+    return obs.export()
+
+
+def test_export_validates_as_chrome_trace(tmp_path):
+    path = _sample_trace(tmp_path)
+    assert path == str(tmp_path / "trace.json")
+    trace = json.load(open(path))
+    assert obs.validate_chrome_trace(trace) == []
+    events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"phase_a", "phase_b"}
+    for ev in events:
+        for key in ("name", "cat", "ph", "ts", "dur", "pid", "tid"):
+            assert key in ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert ev["args"]["run_id"] == obs.get_tracer().run_id
+    # ts are unix-anchored microseconds (multi-host files line up).
+    assert events[0]["ts"] / 1e6 == pytest.approx(time.time(), abs=300)
+    # Monotonic within the thread: sorted export order.
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    meta_names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert "process_name" in meta_names and "thread_name" in meta_names
+
+
+def test_validate_chrome_trace_catches_malformed():
+    assert obs.validate_chrome_trace({}) == ["traceEvents missing or not a list"]
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "a", "cat": "c", "ts": -1.0, "dur": "x",
+         "pid": "zero", "tid": 0},
+        {"ph": "Q"},
+    ]}
+    problems = obs.validate_chrome_trace(bad)
+    assert any("bad ts" in p for p in problems)
+    assert any("bad dur" in p for p in problems)
+    assert any("pid not int" in p for p in problems)
+    assert any("unexpected phase" in p for p in problems)
+
+
+def test_export_without_path_or_tracer_returns_none(tmp_path):
+    assert obs.export() is None  # disabled
+    obs.configure(path=None)
+    assert obs.export() is None  # enabled but no target
+    assert obs.export(str(tmp_path / "explicit.json")) is not None
+
+
+def test_tracing_makes_zero_device_syncs(monkeypatch, tmp_path):
+    """The tracer's contract: spans/exports/dumps never force device
+    work.  A counting shim on jax.block_until_ready plus a source scan —
+    the obs layer must not even spell the name."""
+    import jax
+
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(
+        jax, "block_until_ready",
+        lambda *a, **k: (calls.append(1), real(*a, **k))[1],
+    )
+    obs.configure(path=str(tmp_path / "t.json"))
+    with obs.span("s", "step"):
+        pass
+    obs.snapshot(last_s=1.0)
+    obs.export()
+    obs.flight_dump(str(tmp_path), "test")
+    assert calls == [], "tracing forced a device sync"
+    for fname in os.listdir(os.path.join(REPO, "dwt_tpu", "obs")):
+        src = open(os.path.join(REPO, "dwt_tpu", "obs", fname)).read()
+        # Mentions in comments/docstrings are fine; call sites are not.
+        assert "block_until_ready(" not in src, fname
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_dump_writes_trailing_window_only(tmp_path):
+    obs.configure(path=None)
+    tracer = obs.get_tracer()
+    now = time.perf_counter()
+    tracer.record_complete("ancient", "step", 0.01, end=now - 120.0)
+    tracer.record_complete("recent", "step", 0.01, end=now)
+    path = obs.flight_dump(str(tmp_path / "wd"), "unit_reason")
+    assert path and os.path.exists(path)
+    trace = json.load(open(path))
+    assert obs.validate_chrome_trace(trace) == []
+    assert trace["otherData"]["flight_reason"] == "unit_reason"
+    names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert "recent" in names and "ancient" not in names
+
+
+def test_flight_dump_disabled_is_none(tmp_path):
+    assert obs.flight_dump(str(tmp_path), "r") is None
+    assert not os.listdir(tmp_path)
+
+
+def test_flight_dump_same_second_names_distinct(tmp_path):
+    """A local plus a remote-mirrored guard event at one boundary land
+    in the same second — the second dump must not overwrite the first."""
+    obs.configure(path=None)
+    obs.get_tracer().record_complete("x", "step", 1e-3)
+    d = str(tmp_path / "wd")
+    p1 = obs.flight_dump(d, "first", keep=10)
+    p2 = obs.flight_dump(d, "second", keep=10)
+    assert p1 and p2 and p1 != p2
+    assert os.path.exists(p1) and os.path.exists(p2)
+    assert json.load(open(p1))["otherData"]["flight_reason"] == "first"
+    assert json.load(open(p2))["otherData"]["flight_reason"] == "second"
+
+
+def test_flight_dump_retention_caps_directory(tmp_path):
+    """A flapping guard over a long traced run writes one dump per event
+    — retention must cap the directory (default keep when no watchdog
+    supplies --watchdog_keep)."""
+    obs.configure(path=None)
+    obs.get_tracer().record_complete("x", "step", 1e-3)
+    d = str(tmp_path / "wd")
+    for _ in range(8):
+        assert obs.flight_dump(d, "flap", keep=3)
+    dumps = [n for n in os.listdir(d)
+             if n.startswith("spans-") and n.endswith(".json")]
+    assert len(dumps) <= 3
+
+
+def test_watchdog_stall_dumps_spans_beside_stacks(tmp_path):
+    """In-process fired watchdog: the flight recorder writes the span
+    window next to the stack dump, same retention directory."""
+    from dwt_tpu.resilience.watchdog import HangWatchdog
+
+    obs.configure(path=None)
+    with obs.span("doomed_phase", "step"):
+        time.sleep(0.005)
+    exits = []
+    wd = HangWatchdog(
+        timeout_s=0.2, ckpt_dir=str(tmp_path), _exit=exits.append
+    )
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.05)  # no heartbeat: stall
+    assert wd.fired and exits
+    wd_dir = os.path.join(str(tmp_path), "watchdog")
+    files = os.listdir(wd_dir)
+    assert any(f.startswith("stacks-") for f in files)
+    assert wd.spans_path and os.path.basename(wd.spans_path) in files
+    trace = json.load(open(wd.spans_path))
+    assert obs.validate_chrome_trace(trace) == []
+    assert "watchdog_stall" in trace["otherData"]["flight_reason"]
+    assert "doomed_phase" in [
+        e["name"] for e in trace["traceEvents"] if e["ph"] == "X"
+    ]
+
+
+def test_watchdog_stall_without_tracing_still_exits(tmp_path):
+    """Tracing off: the stall path must behave exactly as before — stack
+    dump + exit, no spans file, no error from the recorder."""
+    from dwt_tpu.resilience.watchdog import HangWatchdog
+
+    exits = []
+    wd = HangWatchdog(
+        timeout_s=0.2, ckpt_dir=str(tmp_path), _exit=exits.append
+    )
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert wd.fired and exits
+    assert wd.spans_path is None
+    files = os.listdir(os.path.join(str(tmp_path), "watchdog"))
+    assert any(f.startswith("stacks-") for f in files)
+    assert not any(f.startswith("spans-") for f in files)
+
+
+def test_guard_event_triggers_flight_dump(tmp_path):
+    """A divergence-guard event dumps the trailing spans BEFORE the
+    recovery/halt path runs (the _StepBoundary seam, minus the loop)."""
+    from dwt_tpu.resilience.guard import DivergenceError
+    from dwt_tpu.train.loop import _StepBoundary
+
+    obs.configure(path=None)
+
+    class _Guard:
+        recoveries = 0
+
+        def step(self, state, metrics, n, gstep):
+            raise DivergenceError("injected non-finite loss")
+
+    class _Preempt:
+        should_stop = False
+
+    class _Coord:
+        enabled = False
+
+    class _Wd:
+        def heartbeat(self):
+            pass
+
+    with obs.span("pre_event_phase", "step"):
+        time.sleep(0.002)
+    boundary = _StepBoundary(
+        _Guard(), _Preempt(), _Coord(), _Wd(),
+        flight_dir=str(tmp_path / "watchdog"),
+    )
+    with pytest.raises(DivergenceError):
+        boundary(object(), {}, 1, gstep=7)
+    dumps = os.listdir(tmp_path / "watchdog")
+    assert len(dumps) == 1 and dumps[0].startswith("spans-")
+    trace = json.load(open(tmp_path / "watchdog" / dumps[0]))
+    assert trace["otherData"]["flight_reason"] == "guard_event_step7"
+    assert "pre_event_phase" in [
+        e["name"] for e in trace["traceEvents"] if e["ph"] == "X"
+    ]
+
+
+@pytest.mark.slow
+def test_chaos_hang_flight_recorder_subprocess(tmp_path):
+    """The full crash story: a traced run hangs mid-training; the
+    watchdog exits 113 leaving BOTH evidence files — stacks (where every
+    thread is) and spans (what they had been doing)."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["DWT_FAULT_PLAN"] = json.dumps({"hang_at_step": 6})
+    env["DWT_OBS_TRACE"] = "1"  # tracing on, no export target needed
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
+            "--synthetic", "--synthetic_size", "32",
+            "--source_batch_size", "8", "--target_batch_size", "8",
+            "--test_batch_size", "16", "--group_size", "4",
+            "--log_interval", "1", "--ckpt_every_epochs", "1",
+            "--epochs", "500", "--watchdog_timeout", "12",
+            "--ckpt_dir", ck,
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.fail("hang outlived the watchdog")
+    from dwt_tpu.resilience import WATCHDOG_EXIT_CODE
+
+    assert proc.returncode == WATCHDOG_EXIT_CODE, stderr.decode()[-2000:]
+    wd_dir = os.path.join(ck, "watchdog")
+    files = os.listdir(wd_dir)
+    stacks = [f for f in files if f.startswith("stacks-")]
+    spans = [f for f in files if f.startswith("spans-")]
+    assert stacks, "no stack dump"
+    assert spans, f"no flight-recorder span dump; files={files}"
+    trace = json.load(open(os.path.join(wd_dir, spans[0])))
+    assert obs.validate_chrome_trace(trace) == []
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    # The window must show the loop's own phases leading into the stall.
+    assert "step_dispatch" in names or "boundary" in names, names
+
+
+# ------------------------------------------- traced digits run + report
+
+
+@pytest.fixture(scope="module")
+def traced_digits_run(tmp_path_factory):
+    """One tiny traced digits CLI run shared by the report/export tests:
+    2 epochs on synthetic data, tracing + heartbeats + metrics on."""
+    from dwt_tpu.cli.usps_mnist import main
+
+    tmp = tmp_path_factory.mktemp("obs_run")
+    trace = str(tmp / "run.trace.json")
+    jsonl = str(tmp / "run.jsonl")
+    obs.disable()
+    try:
+        acc = main([
+            "--synthetic", "--synthetic_size", "32",
+            "--source_batch_size", "8", "--target_batch_size", "8",
+            "--test_batch_size", "16", "--group_size", "4",
+            "--epochs", "2", "--log_interval", "2",
+            "--heartbeat_every", "2",
+            "--obs_trace", trace,
+            "--metrics_jsonl", jsonl,
+        ])
+    finally:
+        obs.disable()  # the CLI enabled the process-global tracer
+    assert 0.0 <= acc <= 100.0
+    return {"trace": trace, "jsonl": jsonl}
+
+
+def test_traced_cli_run_exports_valid_trace(traced_digits_run):
+    trace = json.load(open(traced_digits_run["trace"]))
+    assert obs.validate_chrome_trace(trace) == []
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    # The loop's top-level phases all made it into the export.
+    for expected in ("batch_wait", "step_dispatch", "boundary",
+                     "eval_pass", "eval_dispatch", "batch_build"):
+        assert expected in names, f"missing span {expected}; got {names}"
+
+
+def test_heartbeat_records_in_traced_run(traced_digits_run):
+    recs = [json.loads(l) for l in open(traced_digits_run["jsonl"])]
+    beats = [r for r in recs if r["kind"] == "heartbeat"]
+    assert beats, "no heartbeat records at --heartbeat_every 2"
+    for b in beats:
+        assert b["steps_per_s"] > 0
+        assert b["rss_mb"] > 0
+        assert b["ckpt_in_flight"] in (0, 1)
+
+
+def test_obs_report_accounts_for_100_percent(traced_digits_run, capsys):
+    """Acceptance: the report's phases + explicit unattributed residual
+    account for exactly the loop wall time, and the printed table says
+    so (TOTAL 100.0%)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    report = obs_report.build_report(
+        [traced_digits_run["trace"]], [traced_digits_run["jsonl"]]
+    )
+    tb = report["processes"]["0"]["train"]
+    assert tb["wall_s"] > 0
+    assert tb["n_steps"] == 2 * (32 // 8)  # epochs * steps_per_epoch
+    attributed = sum(p["self_s"] for p in tb["phases"].values())
+    # Exact accounting: self-times + residual == wall (float dust only).
+    assert attributed + tb["unattributed_s"] == pytest.approx(
+        tb["wall_s"], rel=1e-6
+    )
+    shares = sum(p["share"] for p in tb["phases"].values())
+    assert shares + tb["unattributed_share"] == pytest.approx(1.0, abs=1e-4)
+    assert "step_dispatch" in tb["phases"]
+    assert "batch_wait" in tb["phases"]
+    # Metrics merged: the heartbeat series is in the machine summary.
+    assert report["metrics"]["heartbeat"]["count"] >= 1
+
+    rc = obs_report.main([
+        traced_digits_run["trace"], "--metrics", traced_digits_run["jsonl"],
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "unattributed" in out
+    assert "100.0%" in out
+
+
+def test_obs_report_empty_trace_exits_nonzero(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    p = tmp_path / "empty.json"
+    p.write_text(json.dumps({"traceEvents": []}))
+    assert obs_report.main([str(p)]) == 2
+
+
+# ------------------------------------------------------------ serve spans
+
+
+def test_serve_spans_and_stats(tmp_path):
+    """The serving path's spans (admission → plan → build_batch → stage
+    → device → resolve) record with bucket/req_id attrs; req_id joins a
+    span to its access record; /stats surfaces the live process view."""
+    import argparse
+
+    from dwt_tpu.serve.metrics import AccessLog
+    from dwt_tpu.serve.server import ServeClient, build_engine
+
+    obs.configure(path=None)
+    ns = argparse.Namespace(
+        model="lenet", group_size=4, num_classes=10, image_size=28,
+        whitener="cholesky", bf16=False, seed=0, buckets="1,4",
+        data_parallel=False, ckpt_dir=None, init_random=True,
+    )
+    engine = build_engine(ns)
+    access_path = str(tmp_path / "access.jsonl")
+    client = ServeClient(
+        engine, max_batch_delay_ms=2.0, access_log=AccessLog(access_path),
+    )
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        for _ in range(3):
+            out = client.infer(x)
+            assert out.shape == (1, 10)
+        stats = client.stats()
+        assert stats["served_requests"] == 3
+        assert stats["uptime_s"] > 0
+        assert stats["queued_items"] == 0
+        assert stats["in_flight_batches"] == 0
+        assert stats["dispatcher_heartbeat_age_s"] < 30.0
+        assert client.dispatcher_heartbeat_age_s >= 0.0
+    finally:
+        client.close(drain=True)
+        client.access_log.close()
+    recs = obs.snapshot()
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    for phase in ("admission", "plan", "build_batch", "stage", "device",
+                  "resolve"):
+        assert phase in by_name, f"missing serve span {phase}"
+    for r in by_name["device"]:
+        assert r["cat"] == "serve"
+        assert r["attrs"]["bucket"] in (1, 4)
+    span_req_ids = {r["attrs"]["req_id"] for r in by_name["admission"]}
+    access = [json.loads(l) for l in open(access_path)]
+    log_req_ids = {r["req_id"] for r in access if r["status"] == "ok"}
+    assert log_req_ids and log_req_ids <= span_req_ids
+
+
+# ------------------------------------------- metric logger / heartbeats
+
+
+class _CaptureLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, step, sync=False, **values):
+        self.records.append({"kind": kind, "step": step, **values})
+
+
+def test_metric_logger_buffers_jsonl(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(
+        jsonl_path=p, stream=open(os.devnull, "w"),
+        flush_every_n=5, flush_interval_s=3600.0,
+    )
+    for i in range(4):
+        logger.log("train", i, loss=0.1)
+    # Below the cadence: records buffered, nothing durable yet.
+    assert open(p).read() == ""
+    logger.log("train", 4, loss=0.1)  # 5th record -> flush
+    assert len(open(p).read().splitlines()) == 5
+    logger.log("train", 5, loss=0.1)
+    logger.close()  # close flushes the tail
+    lines = open(p).read().splitlines()
+    assert len(lines) == 6
+    assert json.loads(lines[-1])["step"] == 5
+
+
+def test_metric_logger_sync_records_flush_immediately(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(
+        jsonl_path=p, stream=open(os.devnull, "w"),
+        flush_every_n=1000, flush_interval_s=3600.0,
+    )
+    logger.log("train", 0, loss=0.1)
+    assert open(p).read() == ""  # buffered
+    logger.log("preempt", 1, sync=True)  # crash narration: durable NOW
+    lines = open(p).read().splitlines()
+    assert len(lines) == 2  # the sync flush carried the buffered record
+    logger.close()
+
+
+def test_metric_logger_time_based_flush(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(
+        jsonl_path=p, stream=open(os.devnull, "w"),
+        flush_every_n=1000, flush_interval_s=0.0,
+    )
+    logger.log("train", 0, loss=0.1)  # interval 0: every record flushes
+    assert len(open(p).read().splitlines()) == 1
+    logger.close()
+
+
+def test_heartbeat_record_readable_before_close(tmp_path):
+    """The heartbeat is the liveness signal an operator greps DURING a
+    hang — it must hit the file immediately (flush, no fsync) even with
+    the buffering cadence far away, because a hang means no later log()
+    ever runs the cadence flush and a watchdog os._exit skips close()."""
+    p = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(
+        jsonl_path=p, stream=open(os.devnull, "w"),
+        flush_every_n=1000, flush_interval_s=3600.0,
+    )
+    logger.log("train", 0, loss=0.1)  # buffered: not on disk yet
+    assert open(p).read() == ""
+    hb = HeartbeatEmitter(logger, every=1)
+    hb.step(0)
+    hb.step(1)
+    recs = [json.loads(l) for l in open(p).read().splitlines()]
+    # The flush drains the buffer in order: train record then heartbeat.
+    assert [r["kind"] for r in recs] == ["train", "heartbeat"]
+    logger.close()
+
+
+def test_timed_stamps_error_on_raise(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(
+        jsonl_path=p, stream=open(os.devnull, "w"), flush_every_n=1,
+    )
+    with logger.timed("phase", 1, imgs=4):
+        pass
+    with pytest.raises(RuntimeError):
+        with logger.timed("phase", 2):
+            raise RuntimeError("died mid-phase")
+    logger.close()
+    recs = [json.loads(l) for l in open(p)]
+    ok = next(r for r in recs if r["step"] == 1)
+    died = next(r for r in recs if r["step"] == 2)
+    assert "error" not in ok and ok["seconds"] >= 0
+    assert died["error"] is True and died["seconds"] >= 0
+
+
+def test_heartbeat_emitter_cadence_and_fields():
+    logger = _CaptureLogger()
+    hb = HeartbeatEmitter(logger, every=3, in_flight_fn=lambda: 1)
+    hb.step(0)  # primes the window, no record
+    hb.step(1)
+    hb.step(2)
+    assert logger.records == []
+    hb.step(3)  # 3 steps since priming -> first heartbeat
+    assert len(logger.records) == 1
+    rec = logger.records[0]
+    assert rec["kind"] == "heartbeat" and rec["step"] == 3
+    assert rec["steps_per_s"] > 0
+    assert rec["rss_mb"] > 0
+    assert rec["ckpt_in_flight"] == 1
+    hb.step(4)
+    hb.step(5)
+    assert len(logger.records) == 1  # below cadence again
+    hb.step(6)
+    assert len(logger.records) == 2
+
+
+def test_heartbeat_emitter_disabled_is_free():
+    logger = _CaptureLogger()
+    hb = HeartbeatEmitter(logger, every=0)
+    for i in range(10):
+        hb.step(i)
+    assert logger.records == []
+
+
+def test_host_rss_mb_positive():
+    rss = host_rss_mb()
+    assert rss > 1.0  # a python + jax process is way past 1 MB

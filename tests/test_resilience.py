@@ -726,6 +726,10 @@ def test_guard_rollback_restores_checkpoint_and_completes(tmp_path):
     assert valid_steps(_anchor_dir(ck)) == [4, 8, 12]
 
 
+@pytest.mark.slow  # ~23 s — the per-step rollback sibling above stays
+# tier-1; this chunked variant re-proves the same restore walk through
+# the scanned-dispatch boundary (chunk-boundary guard checks are also
+# covered by the chaos matrix).
 def test_guard_rollback_chunked_path(tmp_path):
     """The steps_per_dispatch path only regains host control at chunk
     boundaries; a mid-chunk NaN must still be caught and rolled back."""

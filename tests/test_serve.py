@@ -421,6 +421,69 @@ def test_mismatched_sample_shape_rejected_at_admission(tiny_serve_setup):
         client.close()
 
 
+def test_drain_race_timeout_none_does_not_strand_queued(tiny_serve_setup):
+    """A poll-timeout None from next_batch racing drain() must not make
+    the dispatcher exit with requests still queued — their futures would
+    strand until the client timeout, violating the drain contract
+    ("queued requests keep dispatching until empty").  The dispatcher
+    may only exit on None once the batcher is stopping AND empty."""
+    import threading
+
+    from dwt_tpu.serve import ServeClient
+
+    model, state, engine = tiny_serve_setup
+    client = ServeClient(engine, max_batch_delay_ms=5000.0)
+    try:
+        b = client.batcher
+        real = b.next_batch
+        fired = threading.Event()
+
+        def raced_next_batch(timeout=None):
+            if not fired.is_set():
+                if b.queued_items:
+                    # The race: drain() lands inside a poll that then
+                    # returns a timeout-None with the queue non-empty.
+                    fired.set()
+                    b.drain()
+                    return None
+                return real(timeout=0.05)
+            return real(timeout=timeout)
+
+        b.next_batch = raced_next_batch
+        fut = client.submit(np.zeros((1, 28, 28, 1), np.float32))
+        # Without the queue-empty exit condition the dispatcher returns
+        # on the injected None and this times out.
+        assert fut.result(30.0).shape == (1, 10)
+        assert fired.is_set()
+    finally:
+        client.close(drain=False, timeout=10.0)
+
+
+def test_heartbeat_age_tracks_oldest_inflight_batch(tiny_serve_setup):
+    """A dispatcher wedged inside the device call must show a GROWING
+    heartbeat age even though the batch-wait poll (which runs on the
+    prefetch producer thread) keeps stamping the beat — the age follows
+    the oldest unresolved in-flight batch, falling back to the poll beat
+    only when nothing is in flight."""
+    import time as _time
+
+    from dwt_tpu.serve.server import _Dispatcher
+    from dwt_tpu.serve.batcher import MicroBatcher
+    from dwt_tpu.serve.metrics import AccessLog
+
+    model, state, engine = tiny_serve_setup
+    d = _Dispatcher(engine, MicroBatcher(buckets=engine.buckets),
+                    AccessLog())  # not started: unit-test the property
+    d._beat = _time.monotonic()
+    assert d.heartbeat_age_s < 1.0
+    # A batch pulled 5 s ago and never resolved dominates a fresh beat.
+    d._inflight.append((object(), _time.monotonic() - 5.0))
+    d._beat = _time.monotonic()
+    assert d.heartbeat_age_s >= 5.0
+    d._inflight.popleft()
+    assert d.heartbeat_age_s < 1.0
+
+
 def test_cancelled_future_does_not_kill_dispatcher(tiny_serve_setup):
     """fut.cancel() on a queued request must not blow up the dispatcher
     when it later resolves the batch (set_result on a cancelled Future

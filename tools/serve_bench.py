@@ -154,6 +154,11 @@ def main(argv=None) -> int:
                    help="requests served before timing starts")
     args = p.parse_args(argv)
 
+    # Inherited --obs_trace (server parser): every bench run can emit a
+    # bucket-attributed serving trace for tools/obs_report.py.
+    from dwt_tpu import obs
+
+    obs.maybe_enable(args.obs_trace)
     client, input_shape = _build_client(args)
     rng = np.random.default_rng(args.seed)
     warm = rng.normal(
@@ -172,6 +177,7 @@ def main(argv=None) -> int:
             print(json.dumps(record), flush=True)
     finally:
         client.close(drain=True)
+        obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     return rc
 
 
