@@ -59,6 +59,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dcn_slices", type=int, default=d.dcn_slices,
                    help=">1: 2-D (dcn, data) mesh — pod-level DP across "
                         "slices, per-slice reductions on ICI")
+    p.add_argument("--mesh_shape", type=str, default=d.mesh_shape,
+                   help="sharding-rules engine mesh as 'dcn,data,model' "
+                        "sizes (e.g. 1,2,2); '4' and '2,4' shorthands "
+                        "pad the missing axes to 1.  Unset keeps the "
+                        "legacy single/--data_parallel decision")
+    p.add_argument("--sharding_rules", type=str, default=d.sharding_rules,
+                   help="rules table driving per-leaf placement: preset "
+                        "'dp' (replicate all state — bitwise the legacy "
+                        "paths), preset 'model' (out-channel model "
+                        "sharding, whitening/BN stats pinned replicated), "
+                        "or a path to a JSON [[regex, spec], ...] file")
     p.add_argument("--steps_per_dispatch", type=int,
                    default=d.steps_per_dispatch,
                    help=">1: run k train steps per dispatch (lax.scan "

@@ -42,6 +42,13 @@ class DigitsConfig:
     data_parallel: bool = False  # shard over all local devices
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
+    # Sharding-rules engine (parallel/plan.py): "dcn,data,model" mesh
+    # sizes; None keeps the legacy single/--data_parallel decision.
+    mesh_shape: Optional[str] = None
+    # "dp" (replicate everything — bitwise today's paths), "model"
+    # (out-channel model sharding, stats pinned replicated), or a path
+    # to a JSON [[regex, spec], ...] rules file.
+    sharding_rules: str = "dp"
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
     # Whitening numerics backend (ops/whitening.py Whitener registry):
     # "cholesky" (reference path, default), "newton_schulz" (fixed-K
@@ -150,6 +157,9 @@ class OfficeHomeConfig:
     data_parallel: bool = False
     distributed: bool = False  # multi-host: jax.distributed.initialize()
     dcn_slices: int = 0  # >1: 2-D (dcn, data) mesh for multi-slice DP
+    # Sharding-rules engine — see DigitsConfig.mesh_shape/sharding_rules.
+    mesh_shape: Optional[str] = None
+    sharding_rules: str = "dp"
     pallas_whiten: bool = False  # Pallas whitening kernels (single-chip)
     # Whitening numerics backend — see DigitsConfig.whitener.  "swbn"
     # additionally makes --stat_collection_passes 0 the intended eval

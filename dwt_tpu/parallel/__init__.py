@@ -28,11 +28,35 @@ from dwt_tpu.parallel.dp import (
     shard_batch,
     replicate_state,
 )
+from dwt_tpu.parallel.plan import (
+    MODEL_AXIS,
+    PRESETS,
+    ShardingPlan,
+    load_rules_file,
+    make_plan_mesh,
+    match_partition_rules,
+    parse_mesh_shape,
+    plan_from_config,
+    plan_from_flags,
+    reshard_fn,
+    sharding_requested,
+)
 
 __all__ = [
     "DATA_AXIS",
     "DCN_AXIS",
+    "MODEL_AXIS",
+    "PRESETS",
+    "ShardingPlan",
+    "load_rules_file",
     "make_mesh",
+    "make_plan_mesh",
+    "match_partition_rules",
+    "parse_mesh_shape",
+    "plan_from_config",
+    "plan_from_flags",
+    "reshard_fn",
+    "sharding_requested",
     "initialize_distributed",
     "make_sharded_collect_step",
     "make_sharded_serve_forward",

@@ -41,20 +41,26 @@ def make_sharded_train_step(
     step_fn: Callable,
     mesh: Mesh,
     jit: bool = True,
+    state_specs=None,
 ) -> Callable:
     """shard_map a ``(state, batch) -> (state, metrics)`` step over ``mesh``.
 
     ``step_fn`` must already carry the mesh's axis name(s) internally (grad
     averaging, op moment pmean) — build it with ``axis_name =
-    tuple(mesh.axis_names)`` (a bare string for the 1-D mesh).  State is
-    replicated; every batch leaf is sharded along its leading axis over all
-    mesh axes.
+    tuple(mesh.axis_names)`` (a bare string for the 1-D mesh).  Every batch
+    leaf is sharded along its leading axis over all mesh axes.
+
+    ``state_specs`` is the plan's per-leaf spec pytree for the state
+    (ISSUE-9: the plan — not this wrapper — owns placement); the default
+    ``P()`` prefix replicates every leaf, which under the dp preset is the
+    identical partitioning (and program) either way.
     """
     mapped = _shard_map(
         step_fn,
         mesh=mesh,
-        in_specs=(P(), _batch_spec(mesh)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs if state_specs is not None else P(),
+                  _batch_spec(mesh)),
+        out_specs=(state_specs if state_specs is not None else P(), P()),
     )
     return jax.jit(mapped) if jit else mapped
 
@@ -64,6 +70,7 @@ def make_sharded_scanned_step(
     mesh: Mesh,
     k: int,
     jit: bool = True,
+    state_specs=None,
 ) -> Callable:
     """``make_sharded_train_step`` for a k-steps-per-dispatch chunk.
 
@@ -73,14 +80,16 @@ def make_sharded_scanned_step(
     shard_map the scan body is the same per-replica ``step_fn``, so all
     three cross-replica collectives (moment pmean, grad averaging, metric
     pmean) run per inner step, and numerics match k dispatched steps.
+    ``state_specs``: see :func:`make_sharded_train_step`.
     """
     from dwt_tpu.train.steps import make_scanned_step
 
     mapped = _shard_map(
         make_scanned_step(step_fn, k),
         mesh=mesh,
-        in_specs=(P(), _chunk_spec(mesh)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs if state_specs is not None else P(),
+                  _chunk_spec(mesh)),
+        out_specs=(state_specs if state_specs is not None else P(), P()),
     )
     return jax.jit(mapped) if jit else mapped
 

@@ -228,10 +228,15 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
       the next save/flush.
     """
 
-    def __init__(self):
+    def __init__(self, gather=None):
         super().__init__()
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
+        # ISSUE-9: a sharding plan's gather fn — allgathers model-sharded
+        # leaves back to replicated on the MAIN thread (it is a
+        # collective) so host_fetch sees process-replicated arrays and
+        # the on-disk shard format is unchanged by model parallelism.
+        self._gather = gather
         # Saves are numbered by a per-host sequence counter (identical
         # across hosts: saves come from lockstep control flow).  The
         # done bit gathered by the consensus is a SEQUENCE, not a step:
@@ -302,7 +307,7 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         # multi-host save); an exception here enqueues nothing.  The span
         # is the attribution evidence for exactly that cost.
         with obs.span("ckpt_host_fetch", "ckpt", step=int(step)):
-            host_tree = host_fetch(snapshot_state(state))
+            host_tree = host_fetch(snapshot_state(state), gather=self._gather)
         self._seq += 1
         self._pending_step = int(step)
         self._thread = threading.Thread(

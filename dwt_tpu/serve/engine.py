@@ -17,9 +17,14 @@ makes that forward servable:
 * **compile once per bucket**: ``jax.jit(fwd).lower(...).compile()``
   ahead of time for each fixed bucket shape, so the FIRST request of any
   size pays milliseconds, not an XLA compile;
-* **device-resident**: params/stats/cache are placed on device (replicated
-  over the data mesh under ``--data_parallel``) at load; per-request
-  traffic is just the bucket batch H2D and the logits D2H.
+* **device-resident**: params/stats/cache are placed on device at load
+  through the run's :class:`~dwt_tpu.parallel.ShardingPlan` — replicated
+  replica fan-out under the dp preset, rules-driven model sharding under
+  a gspmd plan (whitening stats and the cache stay replicated per the
+  preset's contract); per-request traffic is just the bucket batch H2D
+  and the logits D2H.  The host-array loose restore plus plan placement
+  is serve's restore-to-spec: each leaf lands directly on its target
+  sharding, no replicated device intermediate.
 """
 
 from __future__ import annotations
@@ -44,10 +49,13 @@ class ServeEngine:
     """Compiled bucket forwards over device-resident weights.
 
     ``input_shape`` is the per-sample shape (e.g. ``(28, 28, 1)`` for
-    digits, ``(224, 224, 3)`` for OfficeHome); ``mesh`` (optional) shards
-    every bucket batch's sample axis over the data mesh — replica
-    fan-out, with bucket sizes rounded UP to mesh multiples so the
-    shards stay equal (pad-and-mask keeps the returned logits exact).
+    digits, ``(224, 224, 3)`` for OfficeHome); ``plan`` (the run's
+    :class:`~dwt_tpu.parallel.ShardingPlan`) shards every bucket batch's
+    sample axis over the plan's data axes — replica fan-out, with bucket
+    sizes rounded UP to data-shard multiples so the shards stay equal
+    (pad-and-mask keeps the returned logits exact; the model axis never
+    shards the batch).  ``mesh=`` is the pre-plan surface, mapped onto
+    the equivalent replica-mode dp plan.
     """
 
     def __init__(
@@ -61,20 +69,27 @@ class ServeEngine:
         whitener: Optional[str] = None,
         whiten_eps: Optional[float] = None,
         eval_domain: Optional[int] = None,
+        plan=None,
         mesh=None,
         input_dtype=np.float32,
         step: Optional[int] = None,
         source: Optional[str] = None,
     ):
+        if plan is None:
+            from dwt_tpu.parallel import ShardingPlan
+
+            plan = ShardingPlan.from_mesh(mesh)
         self.model = model
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
         self.step = step          # checkpoint step served (None: fresh init)
         self.source = source      # "checkpoint" | "anchor" | None
-        self._mesh = mesh
-        if mesh is not None:
+        self._plan = plan
+        self._mesh = plan.mesh
+        if plan.data_size > 1:
             buckets = sorted({
-                -(-int(b) // mesh.size) * mesh.size for b in buckets
+                -(-int(b) // plan.data_size) * plan.data_size
+                for b in buckets
             })
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
 
@@ -97,23 +112,26 @@ class ServeEngine:
             batch_stats
         )
         forward = make_serve_forward(model)
-        if mesh is None:
-            self._x_sharding = None
-            place = jax.device_put
-            fwd = forward
+        self._x_sharding = plan.batch_sharding()
+        fwd = plan.make_serve_forward(forward)
+        # Device residency: the ONE placement of the run, through the
+        # plan.  gspmd places params per the rules table (stats and the
+        # cache pin replicated via the preset's contract); single/replica
+        # replicate everything — today's paths.  Host arrays land
+        # DIRECTLY on their target shardings: serve's restore-to-spec.
+        if plan.mode == "gspmd":
+            placed = plan.place(
+                {"params": params, "batch_stats": batch_stats,
+                 "whiten_cache": cache},
+                "serve state",
+            )
+            self.params = placed["params"]
+            self.batch_stats = placed["batch_stats"]
+            self.cache = placed["whiten_cache"] if cache else cache
         else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from dwt_tpu.parallel import make_sharded_serve_forward
-
-            axes = tuple(mesh.axis_names)
-            self._x_sharding = NamedSharding(mesh, P(axes))
-            place = lambda t: jax.device_put(t, NamedSharding(mesh, P()))
-            fwd = make_sharded_serve_forward(forward, mesh, jit=False)
-        # Device residency: the ONE placement of the run.
-        self.params = place(params)
-        self.batch_stats = place(batch_stats)
-        self.cache = place(cache) if cache else cache
+            self.params = plan.place_replicated(params)
+            self.batch_stats = plan.place_replicated(batch_stats)
+            self.cache = plan.place_replicated(cache) if cache else cache
 
         self._compiled: Dict[int, object] = {}
         self.compile_s: Dict[int, float] = {}

@@ -489,16 +489,18 @@ def _fresh_init_state(model, input_shape, seed: int = 0):
 
 def build_engine(args) -> ServeEngine:
     model, input_shape = build_model(args)
-    mesh = None
-    if args.data_parallel:
-        from dwt_tpu.parallel import make_mesh
+    from dwt_tpu.parallel import plan_from_flags
 
-        mesh = make_mesh()
+    plan = plan_from_flags(
+        mesh_shape=getattr(args, "mesh_shape", None),
+        sharding_rules=getattr(args, "sharding_rules", "dp"),
+        data_parallel=args.data_parallel,
+    )
     buckets = tuple(int(b) for b in args.buckets.split(","))
     if args.ckpt_dir:
         return ServeEngine.from_checkpoint(
             args.ckpt_dir, model, input_shape,
-            buckets=buckets, whitener=args.whitener, mesh=mesh,
+            buckets=buckets, whitener=args.whitener, plan=plan,
         )
     if not args.init_random:
         raise SystemExit(
@@ -508,7 +510,7 @@ def build_engine(args) -> ServeEngine:
     params, stats = _fresh_init_state(model, input_shape, args.seed)
     return ServeEngine(
         model, params, stats, input_shape,
-        buckets=buckets, whitener=args.whitener, mesh=mesh,
+        buckets=buckets, whitener=args.whitener, plan=plan,
     )
 
 
@@ -547,6 +549,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_parallel", action="store_true",
                    help="shard every bucket over all local devices (data "
                         "mesh replica fan-out)")
+    p.add_argument("--mesh_shape", type=str, default=None,
+                   help="sharding-rules engine mesh as 'dcn,data,model' "
+                        "sizes (see the trainer CLIs); buckets shard "
+                        "over the data axes, weights per the rules table")
+    p.add_argument("--sharding_rules", type=str, default="dp",
+                   help="rules table preset ('dp'/'model') or JSON rules "
+                        "file driving weight placement for serving")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8978)
     p.add_argument("--access_log", default=None,

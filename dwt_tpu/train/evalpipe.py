@@ -143,6 +143,7 @@ class EvalPipeline:
         build_model,
         test_batch_size: int,
         *,
+        plan=None,
         mesh=None,
         num_domains: Optional[int] = None,
         eval_k: int = 1,
@@ -152,11 +153,19 @@ class EvalPipeline:
         whiten_eps: float = 1e-3,
         eval_domain: int = 1,
     ):
+        # ``plan`` is the run's ShardingPlan (ISSUE-9: one sharding
+        # authority); ``mesh=`` is the pre-plan surface, mapped onto the
+        # equivalent replica-mode dp plan.
+        if plan is None:
+            from dwt_tpu.parallel import ShardingPlan
+
+            plan = ShardingPlan.from_mesh(mesh)
         self.test_batch_size = int(test_batch_size)
         self.eval_k = max(1, int(eval_k))
         self.num_workers = num_workers
         self.prefetch_size = prefetch_size
-        self._mesh = mesh
+        self._plan = plan
+        self._mesh = plan.mesh
         self._procs = jax.process_count()
         self.last_host_fetches = 0  # evidence stream for the bench/tests
         self._warned_unsharded_collect = False
@@ -169,16 +178,8 @@ class EvalPipeline:
         )
 
         model_free = build_model(axis_name=None)  # axis-free twin
-        if mesh is not None:
-            from dwt_tpu.parallel import (
-                make_sharded_collect_step,
-                make_sharded_eval_step,
-                shard_batch,
-            )
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            axis = tuple(mesh.axis_names)
-            devices = mesh.size
+        if plan.mode != "single":
+            devices = plan.mesh.size
             if devices % self._procs != 0:
                 raise ValueError(
                     f"mesh of {devices} devices cannot split over "
@@ -186,29 +187,39 @@ class EvalPipeline:
                 )
             # Eval-mode forwards are per-sample (running stats, no batch
             # moments), so the global eval batch may be rounded UP to the
-            # device count — masked padding keeps the counters exact and
-            # the reference accuracies unchanged.
-            self._eval_bs = -(-self.test_batch_size // devices) * devices
-            self._replicated = NamedSharding(mesh, P())
-            self._transfer = lambda c: shard_batch(c, mesh, chunked=True)
-            # Counter psum rides the mesh axes; the model stays axis-free
-            # (no train-mode moments on the eval path).
-            self._eval_fn = make_sharded_eval_step(
-                make_accum_eval_step(model_free, axis_name=axis), mesh
+            # batch-shard count — masked padding keeps the counters exact
+            # and the reference accuracies unchanged.  (The model axis
+            # never shards the batch: data_size, not mesh.size.)
+            self._eval_bs = (
+                -(-self.test_batch_size // plan.data_size) * plan.data_size
+            )
+            self._replicated = plan.replicated
+            self._transfer = lambda c: plan.shard_batch(c, chunked=True)
+            # Replica mode: counter psum rides the mesh axes (the model
+            # stays axis-free — no train-mode moments on the eval path).
+            # GSPMD mode: axis-free everything — counters are global
+            # values by jit semantics, the plan pins them replicated.
+            self._eval_fn = plan.make_eval_step(
+                make_accum_eval_step(
+                    model_free, axis_name=plan.eval_axis_name
+                )
             )
             if num_domains is not None:
-                # Collect IS a train-mode forward: the sharded step needs
+                # Collect IS a train-mode forward.  Replica mode needs
                 # the mesh-axis model so norm sites pmean their moments
                 # into global-batch statistics (1-D meshes use the bare
-                # axis name, matching the train path's convention).
-                model_dp = build_model(
-                    axis_name=axis if len(axis) > 1 else axis[0]
+                # axis name, matching the train path's convention);
+                # GSPMD computes global moments from the axis-free model.
+                model_collect = (
+                    build_model(axis_name=plan.step_axis_name)
+                    if plan.mode == "replica" else model_free
                 )
-                self._collect_sharded = make_sharded_collect_step(
+                self._collect_sharded = plan.make_collect_step(
                     make_scanned_collect(
-                        make_stat_collection_step(model_dp, num_domains)
-                    ),
-                    mesh,
+                        make_stat_collection_step(
+                            model_collect, num_domains
+                        )
+                    )
                 )
         else:
             self._eval_bs = self.test_batch_size
@@ -238,19 +249,9 @@ class EvalPipeline:
         return None
 
     def _place(self, tree):
-        """Replicate host values over the mesh (or default device).  On
-        multi-host meshes plain ``device_put`` cannot address remote
-        devices; the global-array assembly path replicates instead."""
-        if self._replicated is None:
-            return jax.device_put(tree)
-        if self._procs == 1:
-            return jax.device_put(tree, self._replicated)
-        return jax.tree.map(
-            lambda a: jax.make_array_from_process_local_data(
-                self._replicated, np.asarray(a)
-            ),
-            tree,
-        )
+        """Replicate host values over the mesh (or default device) —
+        the plan's own replicate path (one implementation repo-wide)."""
+        return self._plan.place_replicated(tree)
 
     def evaluate(self, state, dataset) -> dict:
         """Accumulate eval counters over ``dataset``; one host fetch.
@@ -388,7 +389,7 @@ class EvalPipeline:
         n = len(dataset)
         sharded = (
             self._mesh is not None
-            and bs % self._mesh.size == 0
+            and bs % self._plan.data_size == 0
             and n >= bs
         )
         if self._mesh is not None and not sharded and n >= bs:
@@ -396,10 +397,10 @@ class EvalPipeline:
                 self._warned_unsharded_collect = True
                 log.warning(
                     "stat collection runs unsharded: --test_batch_size "
-                    "%d does not split over the %d-device mesh (padding "
-                    "would perturb the collected moments); eval itself "
-                    "stays sharded",
-                    bs, self._mesh.size,
+                    "%d does not split over the plan's %d batch shards "
+                    "(padding would perturb the collected moments); eval "
+                    "itself stays sharded",
+                    bs, self._plan.data_size,
                 )
         if sharded:
             usable = n - n % bs
@@ -429,6 +430,12 @@ class EvalPipeline:
                 if tail is not None:
                     with obs.span("collect_dispatch", "eval"):
                         state = self._collect_tail(state, self._place(tail))
+                    # The tail step is a plain jit: under a gspmd plan
+                    # its output may carry GSPMD-propagated shardings
+                    # instead of the plan's pinned ones — re-pin, or the
+                    # next explicitly-sharded dispatch raises on the
+                    # mismatch.  No-op everywhere else.
+                    state = self._plan.place(state, "train state")
             return state
         # Unsharded (or tiny-dataset) pipeline: still scanned, prefetched,
         # device-resident; the ragged tail cuts into its own dispatch.
@@ -449,4 +456,7 @@ class EvalPipeline:
                     state = self._collect_scanned(state, xs)
         finally:
             batches.close()
-        return state
+        # The unsharded fallback is a plain jit — re-pin the plan's
+        # shardings before the next explicitly-sharded dispatch (see the
+        # tail path above).  No-op except under a gspmd plan.
+        return self._plan.place(state, "train state")

@@ -79,7 +79,6 @@ from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
     make_digits_train_step,
     make_officehome_train_step,
-    make_scanned_step,
     stack_batches,
 )
 from dwt_tpu.utils import (
@@ -213,18 +212,22 @@ def _multihost_data_split(cfg, bs: int) -> Tuple[int, Optional[Tuple[int, int]]]
 
     Single-process: ``(bs, None)``.  Multi-host: the GLOBAL per-domain batch
     stays at the configured reference value; each process loads a
-    ``1/process_count`` slice and ``shard_batch`` assembles the global
-    arrays — which requires the sharded step, so ``--data_parallel`` is
-    mandatory on multi-host.
+    ``1/process_count`` slice and the plan's batch placement assembles the
+    global arrays — which requires a sharded step, so some sharded
+    execution (``--data_parallel`` or the rules engine) is mandatory on
+    multi-host.
     """
     n = jax.process_count()
     if n == 1:
         return bs, None
-    if not getattr(cfg, "data_parallel", False):
+    from dwt_tpu.parallel import sharding_requested
+
+    if not sharding_requested(cfg):
         raise ValueError(
-            "multi-host runs require --data_parallel: without the sharded "
-            "step there is no gradient/moment sync and every process would "
-            "silently train its own divergent model"
+            "multi-host runs require a sharded step (--data_parallel or "
+            "--mesh_shape/--sharding_rules): without it there is no "
+            "gradient/moment sync and every process would silently train "
+            "its own divergent model"
         )
     if bs % n != 0:
         raise ValueError(
@@ -234,78 +237,28 @@ def _multihost_data_split(cfg, bs: int) -> Tuple[int, Optional[Tuple[int, int]]]
     return bs // n, (jax.process_index(), n)
 
 
-def _maybe_dp(cfg, step_fn_builder, model_kw):
-    """Build ``(model, wrap_step, wrap_batch, (make_chunked, wrap_chunk),
-    mesh)`` for single-device or DP runs (``mesh`` is None off the DP
-    path; the eval/stat pipeline shards over the same mesh).
+def _make_plan(cfg):
+    """The run's :class:`~dwt_tpu.parallel.ShardingPlan` — the ONE
+    sharding authority (ISSUE-9).  Everything placement-shaped — the
+    train step and scanned-chunk dispatch, batch transfer, the eval/stat
+    pipeline, checkpoint save gathers and restore-to-spec — reads this
+    handle; the old ``_maybe_dp`` wrapper plumbing is gone.
 
-    ``make_chunked(raw_step, k)`` compiles a k-steps-per-dispatch variant
-    (lax.scan over ``[k, batch, ...]`` chunks) and ``wrap_chunk`` places a
-    stacked chunk (sample axis sharded on the DP path) — the
-    ``steps_per_dispatch`` machinery.
+    Mode map: no sharding flags → ``single`` (plain jit, today's path
+    byte-for-byte); ``--data_parallel`` (dp preset) → ``replica``
+    (shard_map + explicit collectives, bitwise today's DP path);
+    ``--mesh_shape``/``--sharding_rules`` with a model-sharding table →
+    ``gspmd`` (jit with per-leaf shardings over the named
+    ``(dcn, data, model)`` mesh, axis-free model).
 
-    The returned ``model`` carries the mesh ``axis_name`` when DP is on, so
-    it must only be used *inside* the sharded step — init must go through an
-    axis-free twin (same param/stat shapes), or the traced ``pmean`` runs
-    outside any mesh context and raises "unbound axis name".
+    Models must be built with ``axis_name=plan.step_axis_name`` (the mesh
+    axes in replica mode — sites pmean their moments; None otherwise) and
+    init must go through an axis-free twin: identical param/stat shapes,
+    and no pmean traced outside a mesh context ("unbound axis name").
     """
-    if getattr(cfg, "pallas_whiten", False) and getattr(
-        cfg, "data_parallel", False
-    ):
-        raise ValueError(
-            "--pallas_whiten is single-chip (no cross-replica moment "
-            "pmean); drop it or --data_parallel"
-        )
-    if not getattr(cfg, "data_parallel", False) or jax.device_count() == 1:
-        if (getattr(cfg, "dcn_slices", 0) or 0) > 1:
-            # Fail loudly like --distributed does: silently training
-            # unsharded would waste the whole multi-slice allocation.
-            raise ValueError(
-                "--dcn_slices > 1 requires --data_parallel and more than "
-                "one device — the 2-D (dcn, data) mesh only exists on the "
-                "sharded path"
-            )
-        model = step_fn_builder(axis_name=None, **model_kw)
-        make_chunked = lambda fn, k: jax.jit(
-            make_scanned_step(fn, k), donate_argnums=0
-        )
-        return (
-            model, jax.jit, jax.device_put, (make_chunked, jax.device_put),
-            None,
-        )
-    from dwt_tpu.parallel import (
-        DATA_AXIS,
-        DCN_AXIS,
-        make_mesh,
-        make_sharded_scanned_step,
-        make_sharded_train_step,
-        shard_batch,
-    )
+    from dwt_tpu.parallel import plan_from_config
 
-    bs = getattr(cfg, "source_batch_size", None)
-    if bs is not None and bs % jax.device_count() != 0:
-        raise ValueError(
-            f"--data_parallel shards the per-domain batch over "
-            f"{jax.device_count()} devices, so --source_batch_size "
-            f"(= --target_batch_size) must be divisible by it; got {bs}"
-        )
-    # Multi-slice (pod-level) DP: 2-D (dcn, data) mesh keeps per-slice
-    # reductions on ICI; the model pmeans over BOTH axes.
-    dcn = getattr(cfg, "dcn_slices", 0) or 0
-    if dcn > 1:
-        mesh = make_mesh(dcn_slices=dcn)
-        axis_name = (DCN_AXIS, DATA_AXIS)
-    else:
-        mesh = make_mesh()
-        axis_name = DATA_AXIS
-    model = step_fn_builder(axis_name=axis_name, **model_kw)
-    wrap = lambda fn: make_sharded_train_step(fn, mesh)
-    make_chunked = lambda fn, k: make_sharded_scanned_step(fn, mesh, k)
-    wrap_chunk = lambda c: shard_batch(c, mesh, chunked=True)
-    return (
-        model, wrap, lambda b: shard_batch(b, mesh),
-        (make_chunked, wrap_chunk), mesh,
-    )
+    return plan_from_config(cfg)
 
 
 def _chunk_stream(batches, k: int, should_cut=None, start: int = 0):
@@ -356,13 +309,45 @@ def _run_chunks(state, chunks, raw_step, make_chunked, fns, on_steps):
 
 
 def _params_digest(state: TrainState) -> float:
-    """Order-stable scalar digest of the params, from process-LOCAL data
-    (``addressable_data``, no collective): on a healthy DP/multi-host run
-    every process must log the identical value — the cheap invariant that
-    replicas did not silently diverge."""
+    """Order-stable scalar digest of the params: on a healthy
+    DP/multi-host run every process must log the identical value — the
+    cheap invariant that replicas did not silently diverge.
+
+    Fully-addressable leaves (single-process, incl. model-sharded plans)
+    read the WHOLE array.  Multi-host replicated leaves read shard 0 —
+    each shard IS the replica, no collective.  Multi-host MODEL-SHARDED
+    leaves (shard 0 would be one slice, different per process — exactly
+    the false-divergence signal this digest must never emit) are
+    allgathered first via a jitted identity; the digest call sites (log
+    cadence, end of run) are lockstep on every host, so the collective
+    is legal there."""
+    def _model_sharded(leaf):
+        return (
+            not getattr(leaf, "is_fully_addressable", True)
+            and tuple(leaf.addressable_data(0).shape) != tuple(leaf.shape)
+        )
+
+    params = state.params
+    leaves = jax.tree.leaves(params)
+    sharded = next((l for l in leaves if _model_sharded(l)), None)
+    if sharded is not None:
+        # ONE tree-level jitted-identity allgather (not one collective
+        # per leaf — a ResNet-scale tree would pay ~50 sequential
+        # dispatches per log boundary otherwise).
+        from dwt_tpu.parallel import reshard_fn
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(sharded.sharding.mesh, PartitionSpec())
+        leaves = jax.tree.leaves(reshard_fn(rep)(params))
     total = 0.0
-    for leaf in jax.tree.leaves(state.params):
-        arr = np.asarray(jax.device_get(leaf.addressable_data(0)), np.float64)
+    for leaf in leaves:
+        if getattr(leaf, "is_fully_addressable", True):
+            arr = np.asarray(jax.device_get(leaf), np.float64)
+        else:
+            # Multi-host replicated leaf: shard 0 IS the replica.
+            arr = np.asarray(
+                jax.device_get(leaf.addressable_data(0)), np.float64
+            )
         total += float(np.abs(arr).sum())
     return total
 
@@ -628,11 +613,26 @@ class _CkptPipeline:
     collectives issued at rendezvous points every host reaches together.
     """
 
-    def __init__(self, cfg, coord: Optional[Coordinator] = None):
+    def __init__(self, cfg, coord: Optional[Coordinator] = None, plan=None):
         self._coord = coord
         use_async = bool(cfg.ckpt_dir) and getattr(cfg, "async_ckpt", True)
+        # State-sharding plans (model axis OR an FSDP-style custom table
+        # sharding weights over data/dcn) gather their sharded leaves
+        # (an allgather, main-thread) before the host-shard fetch, so
+        # the on-disk format stays process-replicated and readable by
+        # any plan.
+        gather = (
+            plan.gather if plan is not None and plan.uses_state_sharding
+            else None
+        )
+        # Single-process sharded leaves stay fully addressable (device_get
+        # assembles them), so the gather is only REQUIRED on multi-host —
+        # and it must cover the synchronous paths (save_sync, the
+        # no-async fallback) too, not just the async writer: save_state's
+        # digest/host_fetch raise on non-addressable leaves.
+        self._gather = gather if jax.process_count() > 1 else None
         if use_async and jax.process_count() > 1:
-            self._acp = MultiHostAsyncCheckpointer()
+            self._acp = MultiHostAsyncCheckpointer(gather=gather)
         elif use_async:
             self._acp = AsyncCheckpointer()
         else:
@@ -655,6 +655,8 @@ class _CkptPipeline:
             if self._acp is not None:
                 self._acp.save_multi(targets, step, state)
             else:
+                if self._gather is not None:
+                    state = self._gather(state)
                 for ckpt_dir, kwargs in targets:
                     save_state(ckpt_dir, step, state, **kwargs)
 
@@ -667,6 +669,8 @@ class _CkptPipeline:
         that must know cannot go through the queue."""
         with obs.span("ckpt_sync_save", step=int(step)):
             self.flush()
+            if self._gather is not None:
+                state = self._gather(state)
             return save_state(ckpt_dir, step, state, **kwargs)
 
     def in_flight_depth(self) -> int:
@@ -741,7 +745,8 @@ _restore_newest = restore_newest
 
 
 def _rollback_state(
-    cfg, logger, guard: DivergenceGuard, template, failed_step, coord=None
+    cfg, logger, guard: DivergenceGuard, template, failed_step, coord=None,
+    plan=None,
 ):
     """Recovery state for a ``rollback`` policy hit: the newest valid
     on-disk checkpoint (anchors included), else the guard's last
@@ -762,7 +767,13 @@ def _rollback_state(
             newest = ranked[0][0] if ranked else -1
             agreed = coord.agree_step(newest)
             ranked = [r for r in ranked if r[0] <= agreed]
-        out = _restore_newest(cfg.ckpt_dir, template, ranked)
+        out = _restore_newest(
+            cfg.ckpt_dir, template, ranked,
+            shardings=(
+                plan.restore_shardings(template) if plan is not None
+                else None
+            ),
+        )
         if out is not None:
             restored, source = out
     if restored is None:
@@ -819,18 +830,18 @@ def _read_best_record(ckpt_dir: Optional[str]) -> float:
         return -1.0
 
 
-def _make_eval_pipeline(cfg, build_model, mesh, num_domains=None) -> EvalPipeline:
+def _make_eval_pipeline(cfg, build_model, plan, num_domains=None) -> EvalPipeline:
     """The run's eval/stat fast path (ISSUE-4): device-resident counters
     (O(1) host fetches per pass), ``--eval_steps_per_dispatch`` scanned
-    dispatch, prefetch at the training staging depth, and — when
-    ``--data_parallel`` is on — batches sharded over the same mesh as the
-    train step (composed with the per-process multi-host split).  The
-    pipeline also precomputes each pass's whitening matrices once from
-    the frozen running stats (``--whitener``-aware, site-stacked)."""
+    dispatch, prefetch at the training staging depth, and — under a
+    sharded plan — batches sharded over the same mesh as the train step
+    (composed with the per-process multi-host split).  The pipeline also
+    precomputes each pass's whitening matrices once from the frozen
+    running stats (``--whitener``-aware, site-stacked)."""
     return EvalPipeline(
         build_model,
         cfg.test_batch_size,
-        mesh=mesh,
+        plan=plan,
         num_domains=num_domains,
         eval_k=max(1, getattr(cfg, "eval_steps_per_dispatch", 1)),
         num_workers=cfg.num_workers,
@@ -930,12 +941,11 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             whitener=getattr(cfg, "whitener", "cholesky"),
         )
 
-    model, wrap, wrap_batch, (make_chunked, wrap_chunk), mesh = _maybe_dp(
-        cfg, build_model, {}
-    )
+    plan = _make_plan(cfg)
+    model = build_model(axis_name=plan.step_axis_name)
     sample = jnp.zeros((2, bs, 28, 28, 1), jnp.float32)
     # Init with an axis-free twin: identical param/stat shapes, no pmean
-    # traced outside the mesh (see _maybe_dp docstring).
+    # traced outside the mesh (see _make_plan docstring).
     state = create_train_state(
         build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
     )
@@ -944,8 +954,14 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     if ranked_resume:
         # Resume ranks anchors too: if the main dir's checkpoints were all
         # torn or pruned, restarting from step 0 past a valid anchor would
-        # discard exactly the progress anchors exist to bound.
-        resumed = _restore_newest(cfg.ckpt_dir, state, ranked_resume)
+        # discard exactly the progress anchors exist to bound.  Under a
+        # model-sharded plan the restore is restore-to-spec: each leaf
+        # lands directly on its target sharding, no replicated
+        # intermediate (the HBM spike this engine exists to remove).
+        resumed = _restore_newest(
+            cfg.ckpt_dir, state, ranked_resume,
+            shardings=plan.restore_shardings(state),
+        )
         if resumed is None:
             # Candidates existed but none restored — die loudly rather
             # than silently retrain from scratch over them.
@@ -956,15 +972,22 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         state, src = resumed
         start_epoch = int(state.step) // steps_per_epoch
         logger.log("resume", int(state.step), epoch=start_epoch, source=src)
+    # Fresh-init (or dp-restored) state onto the plan's placement; a
+    # no-op except under a model-sharded plan (single/replica keep
+    # today's uncommitted-leaf flow bitwise).
+    state = plan.place(state, "train state")
 
     raw_step = make_digits_train_step(
         model,
         tx,
         cfg.lambda_entropy_loss,
-        axis_name=getattr(model, "axis_name", None),
+        axis_name=plan.step_axis_name,
     )
-    train_step = wrap(raw_step)
-    evalp = _make_eval_pipeline(cfg, build_model, mesh)
+    train_step = plan.make_train_step(raw_step)
+    wrap_batch = plan.shard_batch
+    make_chunked = plan.make_scanned_step
+    wrap_chunk = lambda c: plan.shard_batch(c, chunked=True)
+    evalp = _make_eval_pipeline(cfg, build_model, plan)
     k_dispatch = max(1, cfg.steps_per_dispatch)
     chunk_fns = {}  # chunk length -> compiled scanned step
 
@@ -976,13 +999,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         logger.log(
             "params_digest", int(state.step), digest=_params_digest(state)
         )
+        # This exit carries the restore-to-spec spans (restore_place,
+        # shard_put) — flush them like every other return path.
+        obs.export()
         return result["accuracy"]
 
     guard = _make_guard(cfg, logger)
     if guard:
         guard.prime(state)
     coord = Coordinator()  # multi-host consensus; single-process: inert
-    ckpt = _CkptPipeline(cfg, coord)
+    ckpt = _CkptPipeline(cfg, coord, plan)
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -1146,7 +1172,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 # timeout budgets a restore, exactly like the unmasked
                 # restore on the startup resume path.
                 state = _rollback_state(
-                    cfg, logger, guard, state, rb.step, coord
+                    cfg, logger, guard, state, rb.step, coord, plan
                 )
                 wd.heartbeat()
                 gstep = int(state.step)
@@ -1340,12 +1366,11 @@ def run_officehome(
             remat=cfg.remat,
         )
 
-    model, wrap, wrap_batch, (make_chunked, wrap_chunk), mesh = _maybe_dp(
-        cfg, build_model, {}
-    )
+    plan = _make_plan(cfg)
+    model = build_model(axis_name=plan.step_axis_name)
     size = cfg.img_crop_size
     sample = jnp.zeros((3, bs, size, size, 3), jnp.float32)
-    # Axis-free init twin (see _maybe_dp docstring).
+    # Axis-free init twin (see _make_plan docstring).
     state = create_train_state(
         build_model(axis_name=None), jax.random.key(cfg.seed), sample, tx
     )
@@ -1357,7 +1382,9 @@ def run_officehome(
     ranked_resume = _ranked_checkpoints(cfg.ckpt_dir) if cfg.ckpt_dir else []
     resuming = bool(ranked_resume)
     if cfg.init_ckpt and not resuming:
-        state = restore_state(cfg.init_ckpt, state)
+        state = restore_state(
+            cfg.init_ckpt, state, shardings=plan.restore_shardings(state)
+        )
         state = state.replace(step=jnp.zeros_like(state.step))
         logger.log("init_ckpt", 0, detail=cfg.init_ckpt)
     elif cfg.resnet_path and not cfg.synthetic and not resuming:
@@ -1383,7 +1410,11 @@ def run_officehome(
     start_iter = 0
     best_acc = -1.0
     if resuming:
-        resumed = _restore_newest(cfg.ckpt_dir, state, ranked_resume)
+        # Restore-to-spec under a model-sharded plan (see run_digits).
+        resumed = _restore_newest(
+            cfg.ckpt_dir, state, ranked_resume,
+            shardings=plan.restore_shardings(state),
+        )
         if resumed is None:
             # Candidates existed (so --init_ckpt was skipped) but none
             # restored: die loudly rather than silently train from init.
@@ -1399,18 +1430,24 @@ def run_officehome(
         best_acc = _read_best_record(cfg.ckpt_dir)
         logger.log("resume", start_iter, source=src)
 
+    # Plan placement after every init/restore path has produced the
+    # state (no-op except under a model-sharded plan — see run_digits).
+    state = plan.place(state, "train state")
     raw_step = make_officehome_train_step(
         model,
         tx,
         cfg.lambda_mec_loss,
-        axis_name=getattr(model, "axis_name", None),
+        axis_name=plan.step_axis_name,
     )
-    train_step = wrap(raw_step)
-    evalp = _make_eval_pipeline(cfg, build_model, mesh, num_domains=3)
+    train_step = plan.make_train_step(raw_step)
+    wrap_batch = plan.shard_batch
+    make_chunked = plan.make_scanned_step
+    wrap_chunk = lambda c: plan.shard_batch(c, chunked=True)
+    evalp = _make_eval_pipeline(cfg, build_model, plan, num_domains=3)
 
     acc = 0.0
     coord = Coordinator()  # multi-host consensus; single-process: inert
-    ckpt = _CkptPipeline(cfg, coord)
+    ckpt = _CkptPipeline(cfg, coord, plan)
     qreg = (
         QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
     )
@@ -1632,7 +1669,7 @@ def run_officehome(
                 # Unmasked: the rollback consensus collectives must stay
                 # watchable (see run_digits).
                 state = _rollback_state(
-                    cfg, logger, guard, state, rb.step, coord
+                    cfg, logger, guard, state, rb.step, coord, plan
                 )
                 wd.heartbeat()
                 start_iter = int(state.step)

@@ -61,6 +61,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dwt_tpu import obs
 from dwt_tpu.resilience import inject
 
 log = logging.getLogger(__name__)
@@ -343,7 +344,7 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def host_fetch(state: Any) -> Any:
+def host_fetch(state: Any, gather: Optional[Callable[[Any], Any]] = None) -> Any:
     """Fetch ``state`` host-side as a pytree of numpy arrays (main thread).
 
     Blocks until the leaves' producing computations finish — this is the
@@ -353,7 +354,15 @@ def host_fetch(state: Any) -> Any:
     requires the state to be process-replicated: a leaf whose local shard
     is narrower than its global shape would silently save one host's
     slice as if it were the world, so it raises instead.
+
+    ``gather`` (ISSUE-9): a sharding plan's gather — an allgather of
+    model-sharded leaves back to replicated, run HERE on the main thread
+    (it is a collective) — so the host-shard on-disk format stays
+    process-replicated no matter how the live state is placed, and both
+    formats remain readable by any plan.
     """
+    if gather is not None:
+        state = gather(state)
 
     def fetch(leaf):
         if hasattr(leaf, "addressable_data") and not getattr(
@@ -529,7 +538,9 @@ def promote_host_shards(
     return final
 
 
-def _restore_host_shards(path: str, template: Any, manifest: dict) -> Any:
+def _restore_host_shards(
+    path: str, template: Any, manifest: dict, shardings: Any = None
+) -> Any:
     """Rebuild ``template``'s pytree from a promoted host-shard checkpoint.
 
     Reads this process's own shard when present (any shard holds the full
@@ -538,6 +549,13 @@ def _restore_host_shards(path: str, template: Any, manifest: dict) -> Any:
     with the template's sharding; non-fully-addressable templates (mid-
     training DP state) go through ``make_array_from_callback`` — local,
     collective-free placement.
+
+    ``shardings`` (restore-to-spec, ISSUE-9): a per-leaf NamedSharding
+    pytree — each leaf is placed DIRECTLY onto its target sharding via
+    ``make_array_from_callback`` (every device receives only its own
+    shard's bytes), with no replicated intermediate: the
+    replicate-then-reshard double allocation is exactly the HBM spike
+    that blocks restoring a backbone larger than one chip.
     """
     mine = os.path.join(path, f"shard_{jax.process_index()}")
     shard_dir = mine if os.path.isdir(mine) else os.path.join(path, "shard_0")
@@ -584,7 +602,27 @@ def _restore_host_shards(path: str, template: Any, manifest: dict) -> Any:
             f"({got[:12]}… != manifest {want[:12]}…)"
         )
 
-    def place(arr, tleaf):
+    sharding_flat = (
+        jax.tree_util.tree_leaves(
+            shardings,
+            is_leaf=lambda x: hasattr(x, "spec"),
+        )
+        if shardings is not None else [None] * len(flat)
+    )
+    if len(sharding_flat) != len(flat):
+        raise ValueError(
+            f"checkpoint {path}: restore shardings have "
+            f"{len(sharding_flat)} leaves; template expects {len(flat)}"
+        )
+
+    def place(arr, tleaf, target):
+        if target is not None:
+            # Restore-to-spec: the leaf lands already-sharded — each
+            # device materializes only its own shard slice, no
+            # replicated intermediate ever exists.
+            return jax.make_array_from_callback(
+                tuple(arr.shape), target, lambda idx: arr[idx]
+            )
         sharding = getattr(tleaf, "sharding", None)
         if sharding is not None and not getattr(
             tleaf, "is_fully_addressable", True
@@ -605,40 +643,85 @@ def _restore_host_shards(path: str, template: Any, manifest: dict) -> Any:
 
         return jnp.asarray(arr)
 
-    return jax.tree_util.tree_unflatten(
-        treedef, [place(a, t) for a, (_, t) in zip(host_leaves, flat)]
-    )
+    with obs.span("restore_place", "shard"):
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                place(a, t, s)
+                for a, (_, t), s in zip(host_leaves, flat, sharding_flat)
+            ],
+        )
 
 
-def _restore_one(path: str, template: Any) -> Any:
+def _restore_one(path: str, template: Any, shardings: Any = None) -> Any:
     manifest = _read_manifest(path)
     if manifest is not None and manifest.get("format") == HOST_SHARD_FORMAT:
-        return _restore_host_shards(path, template, manifest)
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        return _restore_host_shards(path, template, manifest, shardings)
+    if shardings is None:
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    else:
+        # Restore-to-spec on the Orbax format: a sharding-carrying
+        # abstract tree makes Orbax read each device's shard directly
+        # onto its target placement — no replicated intermediate.
+        abstract = jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(
+                tuple(np.shape(l)), np.asarray(l).dtype if not
+                hasattr(l, "dtype") else l.dtype, sharding=s,
+            ),
+            template,
+            shardings,
+        )
 
     def _read():
         with ocp.StandardCheckpointer() as ckptr:
-            return ckptr.restore(path, abstract)
+            with obs.span("restore_place", "shard"):
+                return ckptr.restore(path, abstract)
 
     restored = _with_retries(_read, f"checkpoint restore {path}")
     manifest = _read_manifest(path)
     if manifest is not None and "params_digest" in manifest:
-        got = params_digest(getattr(restored, "params", restored))
-        if got != manifest["params_digest"]:
-            raise ValueError(
-                f"checkpoint {path} failed digest validation "
-                f"({got[:12]}… != manifest {manifest['params_digest'][:12]}…)"
+        restored_params = getattr(restored, "params", restored)
+        if all(
+            getattr(leaf, "is_fully_addressable", True)
+            for leaf in jax.tree_util.tree_leaves(restored_params)
+        ):
+            got = params_digest(restored_params)
+            if got != manifest["params_digest"]:
+                raise ValueError(
+                    f"checkpoint {path} failed digest validation "
+                    f"({got[:12]}… != manifest "
+                    f"{manifest['params_digest'][:12]}…)"
+                )
+        else:
+            # Multi-host restore-to-spec: a model-sharded leaf cannot be
+            # device_get whole without a collective; the per-shard read
+            # path already size-validated, so log instead of gathering.
+            log.info(
+                "skipping digest re-verification for %s: restored leaves "
+                "are not fully addressable (multi-host sharded restore)",
+                path,
             )
     return restored
 
 
-def restore_state(ckpt_dir: str, template: Any, step: Optional[int] = None) -> Any:
+def restore_state(
+    ckpt_dir: str, template: Any, step: Optional[int] = None,
+    shardings: Any = None,
+) -> Any:
     """Restore the checkpoint at ``step`` shaped like ``template``.
 
     ``step=None`` restores the newest checkpoint that both validates and
     restores, walking older candidates on failure (a torn or corrupted
     newest checkpoint falls back instead of killing the resumed job).  An
     explicit ``step`` must be valid and restore cleanly, or this raises.
+
+    ``shardings`` (restore-to-spec): a per-leaf NamedSharding pytree
+    (``ShardingPlan.tree_shardings(template)``) — every leaf is placed
+    directly onto its target sharding as it is read, for BOTH on-disk
+    formats, with no replicate-then-reshard double allocation.  Since the
+    on-disk formats are always process-replicated (save-side gathers
+    model-sharded leaves), any checkpoint restores under any plan: save
+    under dp, restore model-sharded, and vice versa.
     """
     root = _root(ckpt_dir)
     if step is not None:
@@ -648,14 +731,14 @@ def restore_state(ckpt_dir: str, template: Any, step: Optional[int] = None) -> A
                 f"checkpoint step {step} under {ckpt_dir} is missing, "
                 "unfinalized, or truncated"
             )
-        return _restore_one(path, template)
+        return _restore_one(path, template, shardings)
 
     candidates = valid_steps(root)
     errors: List[str] = []
     for s in reversed(candidates):
         path = os.path.join(root, str(s))
         try:
-            restored = _restore_one(path, template)
+            restored = _restore_one(path, template, shardings)
             if errors:
                 log.warning(
                     "restored step %d after skipping invalid newer "
@@ -699,7 +782,8 @@ def ranked_checkpoints(ckpt_dir: str):
     return ranked
 
 
-def restore_newest(ckpt_dir: str, template: Any = None, ranked=None):
+def restore_newest(ckpt_dir: str, template: Any = None, ranked=None,
+                   shardings: Any = None):
     """Restore the newest step that validates AND restores, ranked by
     STEP across the main dir and the anchors dir; ``(state, source)`` or
     None.  Ranking whole directories instead would let a size-valid but
@@ -715,6 +799,7 @@ def restore_newest(ckpt_dir: str, template: Any = None, ranked=None):
     ``template=None`` selects the template-free loose restore
     (:func:`restore_tree`) — the serving path, which has no optimizer and
     therefore no full ``TrainState`` pytree to shape the read.
+    ``shardings``: restore-to-spec targets (see :func:`restore_state`).
     """
     if ranked is None:
         ranked = ranked_checkpoints(ckpt_dir)
@@ -723,7 +808,8 @@ def restore_newest(ckpt_dir: str, template: Any = None, ranked=None):
         try:
             if template is None:
                 return restore_tree(os.path.join(_root(d), str(s))), src
-            return restore_state(d, template, step=s), src
+            return restore_state(d, template, step=s,
+                                 shardings=shardings), src
         except (OSError, ValueError) as e:
             errors.append(f"{src} step {s}: {e}")
             continue

@@ -229,6 +229,46 @@ def test_sharded_collect_parity_uneven_tail(trained_state):
     _assert_tree_close(got.batch_stats, want.batch_stats, 1e-5, 2e-5)
 
 
+def test_gspmd_collect_ragged_tail_keeps_plan_shardings(trained_state):
+    """ISSUE-9 regression: under a model-sharded gspmd plan, the ragged
+    stat-collection tail runs through a PLAIN jit whose output shardings
+    are GSPMD-propagated — the pipeline must re-pin the plan's shardings
+    or the next explicitly-sharded dispatch (collect or train) raises a
+    pjit sharding mismatch.  Also asserts stats parity with the
+    unsharded oracle and that a follow-up plan train dispatch accepts
+    the returned state."""
+    from dwt_tpu.parallel import MODEL_AXIS, PRESETS, ShardingPlan, \
+        make_plan_mesh
+    from dwt_tpu.train import make_digits_train_step
+
+    assert jax.device_count() >= 8
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["model"], name="model"
+    )
+    ds = _dataset(20, seed=9)  # 2 full batches of 8 + ragged 4
+    want = _naive_collect(trained_state, ds, 8, num_domains=2)
+    pipe = EvalPipeline(_build, 8, plan=plan, num_domains=2, eval_k=2)
+    got = pipe.collect_stats(plan.place(trained_state, "train state"), ds)
+    _assert_tree_close(got.batch_stats, want.batch_stats, 1e-5, 2e-5)
+    # The state comes back ON the plan: kernels model-sharded, and the
+    # plan-built train step (explicit in_shardings) accepts it.
+    assert MODEL_AXIS in str(got.params["conv1"]["kernel"].sharding.spec)
+    tx = adam_l2(1e-3)
+    step = plan.make_train_step(
+        make_digits_train_step(_build(), tx, 0.1, axis_name=None)
+    )
+    rng = np.random.default_rng(3)
+    batch = {
+        "source_x": jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(8,))),
+        "target_x": jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32),
+    }
+    # tx state in `trained_state` came from adam_l2(1e-3) too, so the
+    # structures line up; the dispatch itself is the assertion.
+    new_state, metrics = step(got, plan.shard_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 @pytest.mark.slow
 def test_sharded_collect_falls_back_when_indivisible(trained_state, caplog):
     """A batch size that does not split over the mesh must NOT be padded

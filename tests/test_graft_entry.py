@@ -57,10 +57,14 @@ def test_dryrun_reexecs_clean_when_hijack_armed():
 @pytest.mark.slow
 def test_dryrun_16_devices_covers_4_slices_and_consensus():
     """The widened dryrun: a 16-fake-device mesh must exercise BOTH the
-    2x8 and 4x4 (dcn, data) layouts plus the forced consensus allgather
-    (the flag-vector collective the loops issue at step boundaries) —
-    coverage beyond the 8-dev/2-slice corner.  Direct --dryrun subprocess
-    (own XLA device count), no relay re-exec involved."""
+    2x8 and 4x4 (dcn, data) layouts, the full (dcn, data, model) gspmd
+    mesh with a forced gather and a restore-to-spec round trip (the
+    sharding-rules engine end to end, ISSUE-9), plus the forced
+    consensus allgather (the flag-vector collective the loops issue at
+    step boundaries) — coverage beyond the 8-dev/2-slice corner.  Direct
+    --dryrun subprocess (own XLA device count), no relay re-exec
+    involved.  The cheap in-process gspmd smoke stays tier-1 in
+    tests/test_sharding_plan.py."""
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
@@ -73,4 +77,6 @@ def test_dryrun_16_devices_covers_4_slices_and_consensus():
     assert "dryrun_multichip OK: 16-device mesh" in proc.stdout
     assert "2x8 (dcn, data) mesh" in proc.stdout
     assert "4x4 (dcn, data) mesh" in proc.stdout
+    assert "2x4x2 (dcn, data, model) gspmd mesh" in proc.stdout
+    assert "gather + restore-to-spec verified" in proc.stdout
     assert "dryrun consensus OK" in proc.stdout

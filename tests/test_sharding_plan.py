@@ -1,0 +1,538 @@
+"""Sharding-rules engine tests (ISSUE-9): the ShardingPlan contracts.
+
+Four contract groups, mirroring the plan's consumers:
+
+* **rules matching** — ordered first-match-wins ``re.search`` over
+  ``jax.tree_util.keystr`` paths, anchoring, scalar exemption, and the
+  stat/opt-state path shapes (optimizer moments shard WITH their params;
+  whitening/BN running stats pin replicated under the model preset);
+* **fail-fast diagnostics** — a leaf matched by no rule raises listing
+  the full keystr and the active table; duplicate and fully-shadowed
+  rules warn with the winning pattern; specs that cannot apply (rank,
+  divisibility, unknown axis) name leaf + rule + mesh at plan time;
+* **bitwise dp** — the replica-mode plan step IS the historical
+  ``make_sharded_train_step`` program (same wrapper, explicitly-passed
+  all-``P()`` specs), asserted bit-for-bit; plan place→gather round-trips
+  bitwise under the model preset;
+* **restore-to-spec + format cross** — a checkpoint saved under the dp
+  plan restores directly onto model shardings (sharding inspection: the
+  leaves LAND sharded, no replicated intermediate) and vice versa, for
+  BOTH on-disk formats (Orbax and host-shard).
+
+The in-process gspmd smoke here is the tier-1 companion of the
+slow-marked ``__graft_entry__`` dryrun matrix case (16-device subprocess,
+``tests/test_graft_entry.py``).
+"""
+
+import functools
+import json
+import logging
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dwt_tpu.nn import LeNetDWT
+from dwt_tpu.parallel import (
+    MODEL_AXIS,
+    PRESETS,
+    ShardingPlan,
+    load_rules_file,
+    make_mesh,
+    make_plan_mesh,
+    make_sharded_train_step,
+    match_partition_rules,
+    parse_mesh_shape,
+    plan_from_flags,
+    replicate_state,
+    shard_batch,
+)
+from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "source_x": jnp.asarray(rng.normal(size=(n, 28, 28, 1)), jnp.float32),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(n,))),
+        "target_x": jnp.asarray(
+            rng.normal(loc=0.5, size=(n, 28, 28, 1)), jnp.float32
+        ),
+    }
+
+
+@functools.lru_cache(maxsize=1)
+def _lenet_state():
+    """One shared (model, tx, state) init for the whole module — the
+    LeNet init trace is the expensive part of every test here."""
+    model = LeNetDWT(group_size=4)
+    tx = adam_l2(1e-3, 5e-4)
+    batch = _batch()
+    sample = jnp.stack([batch["source_x"], batch["target_x"]])
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    return model, tx, state
+
+
+# ------------------------------------------------------------ rule matching
+
+
+def test_parse_mesh_shape_forms_and_errors():
+    assert parse_mesh_shape("1,4,2") == (1, 4, 2)
+    assert parse_mesh_shape("4") == (1, 4, 1)       # pure DP shorthand
+    assert parse_mesh_shape("2,4") == (2, 4, 1)     # multi-slice DP
+    with pytest.raises(ValueError, match="comma-separated ints"):
+        parse_mesh_shape("2x4")
+    with pytest.raises(ValueError, match="1-3 positive sizes"):
+        parse_mesh_shape("1,2,3,4")
+    with pytest.raises(ValueError, match="1-3 positive sizes"):
+        parse_mesh_shape("0,4")
+
+
+def test_match_rules_first_match_wins_ordered():
+    tree = {"conv": {"kernel": np.zeros((3, 3, 4, 8))},
+            "fc": {"kernel": np.zeros((16, 8))}}
+    specs = match_partition_rules(
+        [
+            (r"\['conv'\]", P(None, None, None, "model")),
+            (r"kernel", P("model", None)),   # fc wins here, conv must not
+            (r".*", P()),
+        ],
+        tree,
+    )
+    assert specs["conv"]["kernel"] == P(None, None, None, "model")
+    assert specs["fc"]["kernel"] == P("model", None)
+
+
+def test_match_rules_anchoring_against_full_keystr():
+    tree = {"a": {"b": np.zeros((4, 4))}, "b": np.zeros((4, 4))}
+    # ^-anchored pattern matches only the top-level 'b' path.
+    specs = match_partition_rules(
+        [(r"^\['b'\]$", P("model", None)), (r".*", P())], tree
+    )
+    assert specs["b"] == P("model", None)
+    assert specs["a"]["b"] == P()
+
+
+def test_scalars_and_single_element_leaves_never_partitioned():
+    tree = {"step": np.asarray(3), "one": np.zeros((1,)),
+            "w": np.zeros((4, 4))}
+    # The table never gets to claim the scalar/1-element leaves — even a
+    # catch-all sharded rule leaves them P().
+    specs = match_partition_rules([(r".*", P("model", None))], tree)
+    assert specs["step"] == P() and specs["one"] == P()
+    assert specs["w"] == P("model", None)
+
+
+def test_model_preset_stat_and_opt_state_path_shapes():
+    """The DWT contract on real TrainState paths: conv/fc kernels (and
+    their optimizer-moment twins) model-shard, whitening/BN running
+    stats and the fc5 head stay replicated."""
+    _, _, state = _lenet_state()
+    specs = match_partition_rules(PRESETS["model"], state)
+    model_dim = P(None, None, None, MODEL_AXIS)
+    assert specs.params["conv1"]["kernel"] == model_dim
+    assert specs.params["fc3"]["kernel"] == P(None, MODEL_AXIS)
+    assert specs.params["fc5"]["kernel"] == P()      # head: replicated
+    # Optimizer moments shard WITH their params (rules match layer
+    # names, not containers).
+    mu = specs.opt_state[1].mu
+    assert mu["conv1"]["kernel"] == model_dim
+    assert mu["fc3"]["kernel"] == P(None, MODEL_AXIS)
+    assert mu["fc5"]["kernel"] == P()
+    # Whitening/BN running stats: REPLICATED — their cross-replica
+    # moment averaging is the algorithm.
+    stats = jax.tree.leaves(
+        match_partition_rules(PRESETS["model"], state.batch_stats)
+    )
+    assert all(s == P() for s in stats)
+
+
+def test_no_match_raises_with_keystr_and_table():
+    tree = {"params": {"conv9": {"kernel": np.zeros((3, 3, 4, 8))}}}
+    with pytest.raises(ValueError) as ei:
+        match_partition_rules(
+            [(r"\['fc\d'\]", P()), (r"bias", P())], tree, what="params"
+        )
+    msg = str(ei.value)
+    assert "['params']['conv9']['kernel']" in msg   # full keystr path
+    # The active table is listed, rules indexed in order.
+    assert "active table" in msg and "[0]" in msg and "fc" in msg
+
+
+def test_shadowed_rule_warns_with_winning_pattern(caplog):
+    tree = {"w": np.zeros((4, 4))}
+    with caplog.at_level(logging.WARNING, logger="dwt_tpu.parallel.plan"):
+        specs = match_partition_rules(
+            [(r".*", P()), (r"\['w'\]", P("model", None))], tree
+        )
+    assert specs["w"] == P()                        # first match won
+    assert any("fully shadowed" in r.message for r in caplog.records)
+    assert any("'.*'" in r.getMessage() for r in caplog.records)
+
+
+def test_duplicate_rule_warns(caplog):
+    mesh = make_plan_mesh((1, 2, 1), jax.devices()[:2])
+    with caplog.at_level(logging.WARNING, logger="dwt_tpu.parallel.plan"):
+        ShardingPlan.gspmd(
+            mesh, [(r".*", P()), (r".*", P(None, "model"))], name="dup"
+        )
+    assert any("duplicate sharding rule" in r.getMessage()
+               for r in caplog.records)
+
+
+def test_spec_validation_names_leaf_rule_and_mesh():
+    mesh = make_plan_mesh((1, 2, 2), jax.devices()[:4])
+    plan = ShardingPlan.gspmd(
+        mesh, [(r"w", P(None, MODEL_AXIS)), (r".*", P())], name="t"
+    )
+    # Divisibility: 5 % 2 != 0 — named leaf, rule, axis, size.
+    with pytest.raises(ValueError, match=r"does not divide 5"):
+        plan.tree_specs({"w": np.zeros((4, 5))})
+    # Rank: spec longer than the leaf's rank.
+    with pytest.raises(ValueError, match=r"rank"):
+        plan.tree_specs({"w": np.zeros((4,))})
+    # Unknown axis name.
+    bad = ShardingPlan.gspmd(
+        mesh, [(r"w", P("nonexistent")), (r".*", P())], name="t2"
+    )
+    with pytest.raises(ValueError, match=r"mesh axes are"):
+        bad.tree_specs({"w": np.zeros((4, 4))})
+
+
+def test_load_rules_file_roundtrip_and_errors(tmp_path):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([
+        [r"(\.|\[')(batch_stats|whiten_cache)", []],
+        [r"conv\w*'\]\['kernel'\]", [None, None, None, "model"]],
+        [r"fsdp", [["data", "model"]]],
+        [r".*", []],
+    ]))
+    rules = load_rules_file(str(path))
+    assert rules[1][1] == P(None, None, None, "model")
+    assert rules[2][1] == P(("data", "model"))       # multi-axis dim
+    assert rules[3][1] == P()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([["(unclosed", []]]))
+    with pytest.raises(ValueError, match="bad regex"):
+        load_rules_file(str(bad))
+    bad.write_text(json.dumps([[".*", "model"]]))
+    with pytest.raises(ValueError, match="spec must be"):
+        load_rules_file(str(bad))
+
+
+# --------------------------------------------------------- flag resolution
+
+
+def test_plan_from_flags_legacy_decisions():
+    # No sharding flags: single mode — today's unsharded path.
+    plan = plan_from_flags()
+    assert plan.mode == "single" and plan.mesh is None
+    assert plan.data_size == 1 and plan.step_axis_name is None
+    # --data_parallel: replica over the historical make_mesh.
+    plan = plan_from_flags(data_parallel=True)
+    assert plan.mode == "replica" and plan.name == "dp"
+    assert plan.data_size == jax.device_count()
+    # Historical error contracts survive the refactor.
+    with pytest.raises(ValueError, match="dcn_slices"):
+        plan_from_flags(dcn_slices=4)
+    with pytest.raises(ValueError, match="divisible"):
+        plan_from_flags(data_parallel=True, batch_size=3)
+    with pytest.raises(ValueError, match="pallas_whiten"):
+        plan_from_flags(data_parallel=True, pallas_whiten=True)
+
+
+def test_plan_from_flags_rules_engine_decisions():
+    plan = plan_from_flags(mesh_shape="1,4,2", sharding_rules="model")
+    assert plan.mode == "gspmd" and plan.uses_model_axis
+    assert plan.data_size == 4                       # model axis: no batch
+    assert plan.step_axis_name is None               # axis-free model
+    # dp rules + a model axis: wasted chips, refused.
+    with pytest.raises(ValueError, match="model axis"):
+        plan_from_flags(mesh_shape="1,2,2", sharding_rules="dp")
+    # dp rules over an explicit mesh shape: the replica engine.
+    plan = plan_from_flags(mesh_shape="2,4", sharding_rules="dp",
+                           data_parallel=True)
+    assert plan.mode == "replica"
+    assert tuple(plan.mesh.devices.shape) == (2, 4)
+    # Batch divisibility is checked against the plan's DATA shards.
+    with pytest.raises(ValueError, match="divisible"):
+        plan_from_flags(mesh_shape="1,4,2", sharding_rules="model",
+                        batch_size=6)
+    # A mesh larger than the device count fails loudly on BOTH engine
+    # branches — the dp-preset path must not silently truncate.
+    with pytest.raises(ValueError, match="devices"):
+        plan_from_flags(mesh_shape="1,64", sharding_rules="dp")
+    with pytest.raises(ValueError, match="devices"):
+        plan_from_flags(mesh_shape="1,64,2", sharding_rules="model")
+    # --data_parallel promises the bitwise shard_map program; a non-dp
+    # rules table routes through gspmd — the conflict must raise, not
+    # silently drop either promise.
+    with pytest.raises(ValueError, match="data_parallel conflicts"):
+        plan_from_flags(data_parallel=True, sharding_rules="model")
+
+
+# ------------------------------------------------- bitwise dp + round trip
+
+
+@pytest.mark.slow
+def test_dp_preset_plan_step_bitwise_vs_legacy_wrapper():
+    """The replica-mode plan step must be the SAME program as the
+    historical make_sharded_train_step wrapper — bit-for-bit, not just
+    close: the plan passes explicit all-P() state specs into the same
+    shard_map.  Slow-marked (t1 budget): the dp-preset bitwise claim
+    stays continuously pinned by the CLI digest A/Bs recorded in
+    CHANGES.md and the replica-mode eval tests; this full two-program
+    compile A/B runs in the slow tier."""
+    model, tx, state = _lenet_state()
+    mesh = make_mesh(jax.devices()[:8])
+    model_dp = LeNetDWT(group_size=4, axis_name="data")
+    raw = make_digits_train_step(model_dp, tx, 0.1, axis_name="data")
+    batch = _batch()
+
+    legacy = make_sharded_train_step(raw, mesh)
+    s_legacy, m_legacy = legacy(
+        replicate_state(state, mesh), shard_batch(batch, mesh)
+    )
+
+    plan = ShardingPlan.replica(mesh)
+    assert plan.step_axis_name == "data"             # 1-D mesh: bare name
+    plan_step = plan.make_train_step(raw)
+    s_plan, m_plan = plan_step(
+        replicate_state(state, mesh), plan.shard_batch(batch)
+    )
+    for a, b in zip(jax.tree.leaves(s_legacy), jax.tree.leaves(s_plan)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m_legacy:
+        np.testing.assert_array_equal(
+            np.asarray(m_legacy[k]), np.asarray(m_plan[k])
+        )
+
+
+def test_gspmd_model_sharded_step_and_gather_roundtrip():
+    """Tier-1 gspmd smoke (the in-process companion of the slow graft
+    dryrun case): plan placement genuinely model-shards the kernels, one
+    axis-free train step keeps them sharded, and place→gather
+    round-trips bitwise."""
+    model, tx, state = _lenet_state()
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["model"], name="model"
+    )
+    placed = plan.place(state, "train state")
+    kernel = placed.params["conv1"]["kernel"]
+    assert MODEL_AXIS in str(kernel.sharding.spec)
+    # 32 out-channels over a model axis of 2: each shard holds 16.
+    assert kernel.addressable_shards[0].data.shape[-1] == 16
+
+    raw = make_digits_train_step(model, tx, 0.1, axis_name=None)
+    step = plan.make_train_step(raw)
+    new_state, metrics = step(placed, plan.shard_batch(_batch()))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(new_state.step) == 1
+    assert MODEL_AXIS in str(
+        new_state.params["conv1"]["kernel"].sharding.spec
+    )
+    # Whitening stats stayed replicated through the sharded step.
+    cov = new_state.batch_stats["dn1"]["whitening"].cov
+    assert cov.sharding.spec == P()
+
+    gathered = plan.gather(new_state)
+    for g, s in zip(jax.tree.leaves(gathered), jax.tree.leaves(new_state)):
+        assert getattr(g.sharding, "spec", P()) == P()
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(g)), np.asarray(jax.device_get(s))
+        )
+
+
+# ------------------------------------- restore-to-spec + ckpt format cross
+
+
+def _host_shard_save(ckpt_dir, step, state):
+    from dwt_tpu.utils.checkpoint import (
+        host_fetch,
+        promote_host_shards,
+        save_host_shard,
+    )
+
+    host = host_fetch(state)
+    assert save_host_shard(ckpt_dir, step, host, process_index=0)
+    return promote_host_shards(ckpt_dir, step, process_count=1)
+
+
+@pytest.mark.parametrize(
+    "fmt",
+    [
+        # Orbax save/restore machinery is the expensive half; the
+        # host-shard param (pure numpy I/O) keeps the cross-plan +
+        # restore-to-spec contract tier-1.  (t1 budget)
+        pytest.param("orbax", marks=pytest.mark.slow),
+        "host_shards",
+    ],
+)
+def test_checkpoint_cross_plan_both_formats(tmp_path, fmt):
+    """Save under the dp plan, restore under the model-sharded plan (the
+    leaves must LAND already-sharded — restore-to-spec, no replicated
+    intermediate) and vice versa, for both on-disk formats."""
+    from dwt_tpu.utils.checkpoint import restore_state, save_state
+
+    _, _, state = _lenet_state()
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["model"], name="model"
+    )
+
+    # dp save -> model-sharded restore.
+    dp_dir = str(tmp_path / "dp")
+    if fmt == "orbax":
+        save_state(dp_dir, 3, state)
+    else:
+        _host_shard_save(dp_dir, 3, state)
+    shardings = plan.restore_shardings(state)
+    assert shardings is not None                     # gspmd: specs exist
+    restored = restore_state(dp_dir, state, shardings=shardings)
+    kernel = restored.params["conv1"]["kernel"]
+    # Restore-to-spec proof: the restored leaf IS on its target sharding.
+    assert kernel.sharding == shardings.params["conv1"]["kernel"]
+    assert kernel.addressable_shards[0].data.shape[-1] == 16
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(kernel)),
+        np.asarray(state.params["conv1"]["kernel"]),
+    )
+    assert int(restored.step) == int(state.step)
+
+    # model-sharded save (gathered on the way out) -> dp restore.
+    sharded_state = plan.place(restored, "train state")
+    md_dir = str(tmp_path / "model")
+    gathered = plan.gather(sharded_state)
+    if fmt == "orbax":
+        save_state(md_dir, 3, gathered)
+    else:
+        _host_shard_save(md_dir, 3, gathered)
+    # dp/single restore: no shardings — today's uncommitted-leaf path.
+    back = restore_state(md_dir, state)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_per_leaf_shard_and_gather_fns():
+    """The SNIPPETS make_shard_and_gather_fns surface: per-leaf
+    callables that place onto the leaf's rules sharding / return it
+    replicated."""
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 2, 2), jax.devices()[:4]), PRESETS["model"],
+        name="model",
+    )
+    tree = {"conv1": {"kernel": jnp.ones((3, 3, 4, 8))},
+            "conv1_bias": {"bias": jnp.ones((8,))}}
+    sfns = plan.shard_fns(tree)
+    placed = jax.tree.map(lambda f, l: f(l), sfns, tree)
+    assert MODEL_AXIS in str(placed["conv1"]["kernel"].sharding.spec)
+    assert placed["conv1_bias"]["bias"].sharding.spec == P()
+    gfns = plan.gather_fns(placed)
+    gathered = jax.tree.map(lambda f, l: f(l), gfns, placed)
+    assert gathered["conv1"]["kernel"].sharding.spec == P()
+    np.testing.assert_array_equal(
+        np.asarray(gathered["conv1"]["kernel"]),
+        np.asarray(tree["conv1"]["kernel"]),
+    )
+
+
+def test_place_is_noop_on_already_placed_leaves():
+    """Leaves already on their target sharding (what restore-to-spec
+    produces) pass through place() untouched — on multi-host the host
+    round-trip they skip would RAISE on non-addressable leaves."""
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 2, 2), jax.devices()[:4]), PRESETS["model"],
+        name="model",
+    )
+    tree = {"conv1": {"kernel": jnp.ones((3, 3, 4, 8))}}
+    placed = plan.place(tree)
+    again = plan.place(placed)
+    assert again["conv1"]["kernel"] is placed["conv1"]["kernel"]
+
+
+def test_uses_state_sharding_covers_fsdp_style_tables():
+    """The save-gather gate must trip on ANY sharded state axis, not
+    just the model axis — an FSDP-style table sharding kernels over
+    'data' leaves state non-process-replicated too."""
+    mesh = make_plan_mesh((1, 4, 2))
+    fsdp = ShardingPlan.gspmd(
+        mesh, [(r"kernel", P(None, None, None, "data")), (r".*", P())],
+        name="fsdp",
+    )
+    assert fsdp.uses_state_sharding and not fsdp.uses_model_axis
+    model = ShardingPlan.gspmd(mesh, PRESETS["model"], name="model")
+    assert model.uses_state_sharding and model.uses_model_axis
+    dp_like = ShardingPlan.gspmd(mesh, PRESETS["dp"], name="dp-ish")
+    assert not dp_like.uses_state_sharding
+    assert not ShardingPlan.single().uses_state_sharding
+
+
+def test_dcn_slices_mesh_shape_mismatch_raises_both_ways():
+    """--dcn_slices N with a mesh dcn axis of 1 must raise too: silently
+    flattening the requested multi-slice topology would push per-slice
+    reductions onto the data-center network."""
+    with pytest.raises(ValueError, match="dcn axis"):
+        plan_from_flags(mesh_shape="1,4,2", sharding_rules="model",
+                        dcn_slices=2)
+    with pytest.raises(ValueError, match="dcn axis"):
+        plan_from_flags(mesh_shape="4,2,1", sharding_rules="model",
+                        dcn_slices=2)
+
+
+def test_replica_and_single_plans_restore_without_shardings():
+    """The non-gspmd paths keep the historical restore byte flow:
+    restore_shardings is None, so leaves come back uncommitted (the
+    multi-host DP resume contract)."""
+    _, _, state = _lenet_state()
+    assert ShardingPlan.single().restore_shardings(state) is None
+    mesh = make_mesh(jax.devices()[:8])
+    assert ShardingPlan.replica(mesh).restore_shardings(state) is None
+
+
+# ------------------------------------------------- off-chip TPU lowering
+
+
+def test_model_sharded_train_step_lowers_for_tpu_offchip():
+    """ISSUE-9 satellite: one model-sharded train step must pass the full
+    TPU lowering off-chip (jax.export) at a representative (1, 4, 2)
+    mesh — the same guard the Pallas kernels carry, extended to the
+    rules-engine path, so a Mosaic/SPMD blocker surfaces here and not on
+    first chip time."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+
+    model, tx, state = _lenet_state()
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["model"], name="model"
+    )
+    st_sh = plan.tree_shardings(state, "train state")
+    raw = make_digits_train_step(model, tx, 0.1, axis_name=None)
+    jitted = jax.jit(
+        raw,
+        in_shardings=(st_sh, plan.batch_sharding()),
+        out_shardings=(st_sh, plan.replicated),
+    )
+    batch = _batch()
+    exp = export.export(jitted, platforms=("tpu",))(
+        jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(
+                jnp.shape(l), jnp.asarray(l).dtype, sharding=s
+            ),
+            state, st_sh,
+        ),
+        jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                jnp.shape(l), jnp.asarray(l).dtype,
+                sharding=plan.batch_sharding(),
+            ),
+            batch,
+        ),
+    )
+    module = exp.mlir_module()
+    assert "sharding" in module                       # SPMD annotations
+    assert exp.nr_devices == 8

@@ -390,7 +390,8 @@ def build_report(trace_paths: List[str],
         if tb is not None:
             proc["train"] = tb
         for cat, key in (("detail", "detail"), ("eval", "eval"),
-                         ("ckpt", "ckpt"), ("data", "data")):
+                         ("ckpt", "ckpt"), ("data", "data"),
+                         ("shard", "shard")):
             s = category_summary(events, pid, cat)
             if s:
                 proc[key] = s
@@ -436,7 +437,9 @@ def main(argv=None) -> int:
         for key, title in (("detail", "boundary detail spans"),
                            ("eval", "eval pipeline"),
                            ("ckpt", "checkpoint pipeline"),
-                           ("data", "prefetch producer")):
+                           ("data", "prefetch producer"),
+                           ("shard", "sharding plan (place/gather/"
+                                     "restore)")):
             if key in proc:
                 print_category(f"{title} (pid {pid})", proc[key])
         if "serve" in proc:

@@ -1,0 +1,252 @@
+"""Sharding-plan microbench: restore-to-spec vs replicate-then-reshard,
+and the per-step cost of rules-driven specs vs the historical wrappers.
+
+Two questions, answered with numbers (PERF.md "Sharding plan"):
+
+1. **Restore placement** — the rules engine's restore-to-spec places
+   every checkpoint leaf DIRECTLY onto its target sharding
+   (``restore_state(..., shardings=plan.tree_shardings(t))``, via
+   ``make_array_from_callback``), where the naive path restores
+   replicated and then reshards (``restore_state(...)`` +
+   ``plan.place(...)``).  The naive path's transient peak holds BOTH
+   copies live — the replicated tree and the resharded one — which is
+   exactly the HBM spike that blocks restoring a backbone larger than
+   one chip.  Each arm runs in its OWN subprocess so ``ru_maxrss`` is a
+   clean per-arm high-water mark; device-buffer bytes are computed from
+   the live arrays' addressable shards at the steady state and at the
+   naive arm's double-allocation point.
+
+2. **Step dispatch** — the dp-preset replica plan must cost the same
+   per step as the historical ``make_sharded_train_step`` wrapper (it
+   is the SAME shard_map program with explicit all-``P()`` specs); the
+   rules engine adds one table match at trace time, nothing per step.
+   Timed as median per-step wall over ``--steps`` post-warmup steps,
+   legacy wrapper vs plan, on the same mesh.
+
+Run on CPU fake devices (the dryrun meshes)::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/shard_bench.py
+
+Prints one JSON record; ``--arm`` is the internal per-subprocess entry.
+"""
+
+import argparse
+import json
+import os
+import resource
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(model_name: str):
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.nn import LeNetDWT, ResNetDWT
+    from dwt_tpu.train import adam_l2, create_train_state
+
+    tx = adam_l2(1e-3)
+    if model_name == "lenet":
+        model = LeNetDWT(group_size=4)
+        sample = jnp.zeros((2, 8, 28, 28, 1), jnp.float32)
+    else:
+        model = ResNetDWT.resnet50(group_size=4, num_classes=65)
+        sample = jnp.zeros((3, 2, 64, 64, 3), jnp.float32)
+    state = create_train_state(model, jax.random.key(0), sample, tx)
+    return model, tx, state
+
+
+def _plan(n_devices: int):
+    from dwt_tpu.parallel import PRESETS, ShardingPlan, make_plan_mesh
+
+    shape = (1, n_devices // 2, 2)
+    return ShardingPlan.gspmd(
+        make_plan_mesh(shape), PRESETS["model"], name="model"
+    ), shape
+
+
+def _device_bytes(tree):
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            total += sum(s.data.nbytes for s in leaf.addressable_shards)
+        else:
+            total += getattr(leaf, "nbytes", 0)
+    return int(total)
+
+
+def _run_arm(arm: str, model_name: str, ckpt_dir: str) -> None:
+    """Subprocess entry: one restore arm, clean ru_maxrss."""
+    import jax
+
+    from dwt_tpu.utils.checkpoint import restore_state, save_state
+
+    model, tx, state = _build(model_name)
+    plan, _ = _plan(jax.device_count())
+    if not os.listdir(ckpt_dir):
+        save_state(ckpt_dir, 1, state)
+
+    t0 = time.perf_counter()
+    if arm == "restore_to_spec":
+        restored = restore_state(
+            ckpt_dir, state, shardings=plan.restore_shardings(state)
+        )
+        jax.block_until_ready(restored)
+        wall_s = time.perf_counter() - t0
+        steady = _device_bytes(restored)
+        peak_bytes = steady
+    else:  # replicate_reshard
+        replicated = restore_state(ckpt_dir, state)
+        replicated = jax.device_put(replicated, plan.replicated)
+        jax.block_until_ready(replicated)
+        resharded = plan.place(replicated, "train state")
+        jax.block_until_ready(resharded)
+        wall_s = time.perf_counter() - t0
+        # Double-allocation point: both trees are live RIGHT NOW.
+        peak_bytes = _device_bytes(replicated) + _device_bytes(resharded)
+        steady = _device_bytes(resharded)
+        del replicated
+    print(json.dumps({
+        "arm": arm,
+        "wall_s": round(wall_s, 4),
+        "steady_device_mb": round(steady / 2**20, 2),
+        "peak_device_mb": round(peak_bytes / 2**20, 2),
+        "ru_maxrss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1
+        ),
+    }))
+
+
+def _median_step_ms(step, state, batch, steps: int) -> float:
+    import jax
+
+    new_state, _ = step(state, batch)          # compile + first dispatch
+    jax.block_until_ready(new_state)
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        new_state, metrics = step(new_state, batch)
+        jax.block_until_ready((new_state, metrics))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+
+def _bench_steps(model_name: str, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.parallel import (
+        ShardingPlan,
+        make_mesh,
+        make_sharded_train_step,
+        replicate_state,
+        shard_batch,
+    )
+    from dwt_tpu.train import make_digits_train_step
+
+    assert model_name == "lenet", "step A/B runs the digits step (lenet)"
+    model, tx, state = _build(model_name)
+    n = jax.device_count()
+    rng = np.random.default_rng(0)
+    batch = {
+        "source_x": jnp.asarray(rng.normal(size=(n, 28, 28, 1)), jnp.float32),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(n,))),
+        "target_x": jnp.asarray(rng.normal(size=(n, 28, 28, 1)), jnp.float32),
+    }
+    mesh = make_mesh()
+    axis = "data" if len(mesh.axis_names) == 1 else tuple(mesh.axis_names)
+    model_dp = LeNetDWT(group_size=4, axis_name=axis)
+    raw = make_digits_train_step(model_dp, tx, 0.1, axis_name=axis)
+
+    legacy = make_sharded_train_step(raw, mesh)
+    legacy_ms = _median_step_ms(
+        legacy, replicate_state(state, mesh), shard_batch(batch, mesh), steps
+    )
+
+    plan = ShardingPlan.replica(mesh)
+    plan_step = plan.make_train_step(raw)
+    plan_ms = _median_step_ms(
+        plan_step, replicate_state(state, mesh), plan.shard_batch(batch),
+        steps,
+    )
+    return {
+        "devices": n,
+        "steps": steps,
+        "legacy_dp_step_ms": round(legacy_ms, 2),
+        "plan_dp_step_ms": round(plan_ms, 2),
+        "overhead_x": round(plan_ms / legacy_ms, 3),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="sharding-plan restore + step-overhead microbench"
+    )
+    p.add_argument("--model", choices=["lenet", "resnet50"], default="lenet")
+    p.add_argument("--steps", type=int, default=30,
+                   help="timed steps for the per-step A/B")
+    p.add_argument("--arm", default=None,
+                   help="(internal) subprocess restore arm")
+    p.add_argument("--ckpt_dir", default=None,
+                   help="(internal) shared checkpoint dir for the arms")
+    args = p.parse_args(argv)
+
+    if args.arm:
+        _run_arm(args.arm, args.model, args.ckpt_dir)
+        return 0
+
+    # Force the CPU dryrun mesh in THIS process too (jax is only
+    # imported inside the bench fns, so this is early enough) — the
+    # parent runs the step A/B itself.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env = dict(os.environ)
+
+    record = {"model": args.model, "restore": {}}
+    with tempfile.TemporaryDirectory() as td:
+        # Seed the checkpoint once (restore_to_spec arm runs first and
+        # writes it; the dir is shared so both arms read the same bytes).
+        for arm in ("restore_to_spec", "replicate_reshard"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--arm", arm, "--model", args.model, "--ckpt_dir", td],
+                env=env, capture_output=True, text=True, timeout=1200,
+            )
+            if proc.returncode != 0:
+                print(proc.stderr[-2000:], file=sys.stderr)
+                return 1
+            line = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+            record["restore"][arm] = json.loads(line[-1])
+    r2s = record["restore"]["restore_to_spec"]
+    naive = record["restore"]["replicate_reshard"]
+    record["restore"]["peak_device_mb_saved"] = round(
+        naive["peak_device_mb"] - r2s["peak_device_mb"], 2
+    )
+    record["restore"]["wall_speedup_x"] = round(
+        naive["wall_s"] / max(r2s["wall_s"], 1e-9), 2
+    )
+
+    if args.model == "lenet":
+        record["step_ab"] = _bench_steps(args.model, args.steps)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
