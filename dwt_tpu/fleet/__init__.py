@@ -1,0 +1,30 @@
+"""dwt_tpu.fleet — continuous deployment for the serving path (ISSUE-11).
+
+Closes the train → serve loop: the training loop keeps writing
+checkpoints; every serving replica watches the same ``ckpt_dir``
+(:mod:`~dwt_tpu.fleet.watcher` — the resilience layer's own
+newest-valid ranked walk, so unpromoted/torn steps are invisible by
+construction), gates each candidate through a fixture eval
+(:mod:`~dwt_tpu.fleet.canary`), hot-swaps it into the live engine as
+one atomic pointer flip between dispatches
+(:mod:`~dwt_tpu.fleet.reload` + ``ServeEngine.swap`` — in-flight
+buckets finish on the old version, no mixed-version batch ever), and
+auto-rolls back to the last-good version when the post-swap access-log
+windows regress.  :mod:`~dwt_tpu.fleet.balancer` (``dwt-fleet``) fronts
+N replica subprocesses with a least-outstanding-requests load balancer:
+per-replica health off ``/healthz``, 503/connect-error ejection with
+re-admission, SIGTERM → drain every replica → exit 0.
+"""
+
+from dwt_tpu.fleet.canary import CanaryGate, CanaryVerdict, PostSwapMonitor
+from dwt_tpu.fleet.reload import HotReloader
+from dwt_tpu.fleet.watcher import Candidate, CheckpointWatcher
+
+__all__ = [
+    "Candidate",
+    "CheckpointWatcher",
+    "CanaryGate",
+    "CanaryVerdict",
+    "PostSwapMonitor",
+    "HotReloader",
+]

@@ -1,0 +1,540 @@
+"""Replica fleet + front load balancer: ``dwt-fleet``.
+
+One balancer process fronts N ``dwt-serve`` replica subprocesses — all
+serving the same model, all watching the same ``ckpt_dir`` (each replica
+runs its own hot-reload loop, so a new checkpoint rolls across the fleet
+replica by replica with the canary gating each one independently).
+
+* **routing** — least-outstanding-requests: every proxied ``/infer``
+  picks the healthy replica with the fewest requests currently in
+  flight through the balancer (the cheapest load signal that tracks the
+  replicas' actual queue depth without polling them per request); ties
+  break round-robin.
+* **health** — a prober thread polls each replica's ``/healthz`` every
+  ``--health_interval_s``: a non-200 (the server answers 503 with a dead
+  dispatcher), a connect failure, or a dead subprocess EJECTS the
+  replica from routing; a later healthy probe RE-ADMITS it (a replica
+  that answered 503 while draining or overloaded comes back by itself).
+  The probe also reads ``dispatcher_heartbeat_age_s`` — a replica whose
+  dispatcher is wedged (age far past the poll period with work queued)
+  is ejected even though its listener still answers 200s.
+* **keep-alive upstream** — proxied requests reuse pooled persistent
+  connections per replica (:class:`~dwt_tpu.serve.server
+  .HttpServeClient` semantics); without it the balancer would pay a TCP
+  connect per proxied request.
+* **drain** — SIGTERM/SIGINT: stop admitting (503 + Retry-After),
+  forward SIGTERM to every replica, wait for each to finish its own
+  graceful drain (exit 0), then exit 0 — the whole fleet honors the
+  single-server drain contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import logging
+import select
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import List, Optional, Sequence
+
+from dwt_tpu.serve.server import DrainAwareHandler
+
+log = logging.getLogger(__name__)
+
+
+class _ConnPool:
+    """Tiny per-replica pool of persistent HTTP connections.
+
+    ``get``/``put`` bracket one proxied request; a connection that died
+    mid-request is closed (not returned), so the pool self-heals after a
+    replica restart.  Bounded: beyond ``cap`` idle connections are
+    closed rather than kept (handler threads come and go)."""
+
+    def __init__(self, host: str, port: int, timeout: float, cap: int = 16):
+        self.host, self.port, self.timeout, self.cap = (
+            host, int(port), float(timeout), int(cap)
+        )
+        self._lock = threading.Lock()
+        self._idle: List[http.client.HTTPConnection] = []
+
+    def get(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+
+    def put(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._idle) < self.cap:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+class Replica:
+    """One serving backend: subprocess-owned or external (tests)."""
+
+    def __init__(self, rid: int, host: str, port: int,
+                 proc: Optional[subprocess.Popen] = None,
+                 timeout: float = 70.0):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+        self.proc = proc
+        self.pool = _ConnPool(host, port, timeout)
+        self.healthy = True
+        self.outstanding = 0
+        self.served = 0
+        self.failures = 0          # lifetime proxy/probe failures
+        self.last_health: dict = {}
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is None or self.proc.poll() is None
+
+    def describe(self) -> dict:
+        return {
+            "rid": self.rid, "port": self.port, "pid": self.pid,
+            "healthy": self.healthy, "outstanding": self.outstanding,
+            "served": self.served, "failures": self.failures,
+            "version": self.last_health.get("version"),
+        }
+
+
+class ReplicaSet:
+    """Routing + health state over the fleet's replicas."""
+
+    def __init__(self, replicas: Sequence[Replica]):
+        self.replicas = list(replicas)
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    def pick(self) -> Optional[Replica]:
+        """Healthy replica with the fewest outstanding proxied requests
+        (ties round-robin); reserves a slot (caller MUST release)."""
+        with self._lock:
+            healthy = [r for r in self.replicas if r.healthy]
+            if not healthy:
+                return None
+            least = min(r.outstanding for r in healthy)
+            tied = [r for r in healthy if r.outstanding == least]
+            choice = tied[self._rr % len(tied)]
+            self._rr += 1
+            choice.outstanding += 1
+            return choice
+
+    def release(self, replica: Replica, ok: bool) -> None:
+        with self._lock:
+            replica.outstanding = max(0, replica.outstanding - 1)
+            if ok:
+                replica.served += 1
+
+    def eject(self, replica: Replica, reason: str) -> None:
+        with self._lock:
+            first = replica.healthy
+            replica.healthy = False
+            replica.failures += 1
+        if first:
+            log.warning("fleet: replica %d ejected (%s)",
+                        replica.rid, reason)
+
+    def readmit(self, replica: Replica) -> None:
+        with self._lock:
+            if replica.healthy:
+                return
+            replica.healthy = True
+        log.info("fleet: replica %d re-admitted", replica.rid)
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(r.healthy for r in self.replicas)
+
+    def describe(self) -> List[dict]:
+        with self._lock:
+            return [r.describe() for r in self.replicas]
+
+
+class HealthProber(threading.Thread):
+    """Periodic /healthz probe per replica: eject on failure, re-admit
+    on recovery.  A dead subprocess is ejected permanently (its port
+    answers nothing; re-admission would need a respawn policy — out of
+    scope, the fleet keeps serving on the survivors)."""
+
+    def __init__(self, replicas: ReplicaSet, interval_s: float = 1.0,
+                 timeout_s: float = 2.0, max_heartbeat_age_s: float = 30.0):
+        super().__init__(name="dwt-fleet-health", daemon=True)
+        self.replicas = replicas
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.max_heartbeat_age_s = float(max_heartbeat_age_s)
+        # NB: not `_stop` — threading.Thread has a private method of
+        # that name and shadowing it breaks join().
+        self._stop_evt = threading.Event()
+
+    def probe_once(self) -> None:
+        for r in self.replicas.replicas:
+            if not r.alive:
+                self.replicas.eject(
+                    r, f"process exited rc={r.proc.returncode}"
+                )
+                continue
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(
+                    r.host, r.port, timeout=self.timeout_s
+                )
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                self.replicas.eject(r, f"probe failed: {e}")
+                continue
+            finally:
+                if conn is not None:
+                    conn.close()
+            r.last_health = body
+            if resp.status != 200:
+                self.replicas.eject(r, f"/healthz {resp.status}")
+            elif body.get("draining"):
+                # A draining replica answers /healthz 200 (its dispatcher
+                # is fine) but sheds every /infer with 503 — routing to
+                # it turns an orderly single-replica drain into
+                # client-visible errors while healthy replicas idle.
+                self.replicas.eject(r, "draining")
+            elif (body.get("dispatcher_heartbeat_age_s", 0.0)
+                    > self.max_heartbeat_age_s
+                    and body.get("queued_items", 0) > 0):
+                # Wedged-but-listening: alive listener, hung dispatcher.
+                self.replicas.eject(
+                    r,
+                    "dispatcher heartbeat age "
+                    f"{body['dispatcher_heartbeat_age_s']}s with work "
+                    "queued",
+                )
+            else:
+                self.replicas.readmit(r)
+
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.probe_once()
+            except Exception:
+                log.exception("fleet: health probe pass failed")
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        self.join(timeout)
+
+
+# --------------------------------------------------------------- HTTP front
+
+class _BalancerHandler(DrainAwareHandler):
+    """The balancer's front end: the serve handler's keep-alive/drain
+    behavior (shared :class:`~dwt_tpu.serve.server.DrainAwareHandler`
+    base — one implementation of the idle wait and body-draining
+    replies) plus the proxy routing."""
+
+    # Set by make_handler:
+    replicas: ReplicaSet = None       # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):
+        log.debug("balancer http: " + fmt, *args)
+
+    # -------------------------------------------------------------- proxy
+
+    def _proxy(self, method: str, path: str, body: Optional[bytes],
+               headers: dict) -> None:
+        """Forward one request to the least-loaded healthy replica over a
+        pooled keep-alive connection; on a connect/send failure (request
+        never reached the replica) eject it and retry the next one —
+        bounded by the fleet size.  A failure AFTER the send is surfaced,
+        not retried: ``/infer`` is not idempotent."""
+        tried = 0
+        total = len(self.replicas.replicas)
+        while tried < total:
+            replica = self.replicas.pick()
+            if replica is None:
+                break
+            tried += 1
+            conn = replica.pool.get()
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self.replicas.release(replica, ok=False)
+                if sent:
+                    # The replica may have served it; a retry could
+                    # double-apply.  Tell the client honestly.
+                    self.replicas.eject(replica, f"proxy recv failed: {e}")
+                    self._reply(502, {
+                        "error": f"replica {replica.rid} failed "
+                        f"mid-response: {e}",
+                    })
+                    return
+                self.replicas.eject(replica, f"proxy connect failed: {e}")
+                continue  # safe retry on another replica
+            replica.pool.put(conn)
+            self.replicas.release(replica, ok=resp.status == 200)
+            self.send_response(resp.status)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Content-Length", str(len(data)))
+            retry_after = resp.getheader("Retry-After")
+            if retry_after:
+                self.send_header("Retry-After", retry_after)
+            self.send_header("X-DWT-Replica", str(replica.rid))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self._reply(503, {
+            "error": "no healthy replica",
+            "retry_after_ms": 1000,
+        }, headers=[("Retry-After", "1")])
+
+    def do_POST(self):
+        body = self.read_body()  # ALWAYS, even on error paths (keep-alive)
+        if self.path not in ("/infer", "/v1/infer"):
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        if self.draining.is_set():
+            self._reply(503, {
+                "error": "draining", "retry_after_ms": 1000,
+            }, headers=[("Retry-After", "1")])
+            return
+        self._proxy("POST", self.path, body,
+                    {"Content-Type": "application/json"})
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            healthy = self.replicas.healthy_count()
+            self._reply(200 if healthy > 0 else 503, {
+                "ok": healthy > 0,
+                "draining": bool(self.draining.is_set()),
+                "healthy_replicas": healthy,
+                "replicas": self.replicas.describe(),
+            })
+        elif self.path == "/stats":
+            # Aggregate: fleet-level counts + each replica's own /stats
+            # (proxied with a short timeout; an unreachable replica
+            # reports its describe() only).
+            out = {"kind": "fleet_stats",
+                   "replicas": self.replicas.describe(), "stats": {}}
+            for r in self.replicas.replicas:
+                if not r.healthy:
+                    continue
+                try:
+                    conn = http.client.HTTPConnection(
+                        r.host, r.port, timeout=2.0
+                    )
+                    conn.request("GET", "/stats")
+                    resp = conn.getresponse()
+                    out["stats"][str(r.rid)] = json.loads(resp.read())
+                    conn.close()
+                except (OSError, http.client.HTTPException, ValueError):
+                    pass
+            self._reply(200, out)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+
+def make_handler(replicas: ReplicaSet, draining: threading.Event):
+    return type("BalancerHandler", (_BalancerHandler,), {
+        "replicas": replicas, "draining": draining,
+    })
+
+
+# ------------------------------------------------------------ fleet spawn
+
+def spawn_replica(rid: int, serve_argv: List[str],
+                  host: str = "127.0.0.1",
+                  ready_timeout_s: float = 300.0) -> Replica:
+    """Start one ``dwt-serve`` subprocess on an ephemeral port and wait
+    for its ``serve_ready`` line (which carries the bound port)."""
+    cmd = [sys.executable, "-m", "dwt_tpu.serve.server",
+           "--host", host, "--port", "0", *serve_argv]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    deadline = time.monotonic() + ready_timeout_s
+    line = ""
+    while time.monotonic() < deadline:
+        # select before readline: a replica wedged BEFORE printing
+        # anything (stuck restore/compile) must hit the deadline, not
+        # block fleet startup forever inside a blocking readline.
+        ready_fds, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if not ready_fds:
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"replica {rid} exited before ready "
+                f"(rc={proc.poll()}): {' '.join(cmd)}"
+            )
+        try:
+            ready = json.loads(line)
+        except ValueError:
+            continue  # stray logging on stdout
+        if ready.get("kind") == "serve_ready":
+            log.info("fleet: replica %d ready on port %d (version %s)",
+                     rid, ready["port"], ready.get("version"))
+            return Replica(rid, host, ready["port"], proc=proc)
+    proc.kill()
+    raise RuntimeError(f"replica {rid} not ready within "
+                       f"{ready_timeout_s}s (last line: {line!r})")
+
+
+def drain_fleet(replicas: Sequence[Replica], timeout_s: float = 120.0) -> int:
+    """SIGTERM every live replica, wait for their graceful drains.
+    Returns the number that exited nonzero/not-at-all (0 = clean)."""
+    for r in replicas:
+        if r.proc is not None and r.proc.poll() is None:
+            r.proc.send_signal(signal.SIGTERM)
+    bad = 0
+    deadline = time.monotonic() + timeout_s
+    for r in replicas:
+        if r.proc is None:
+            continue
+        try:
+            rc = r.proc.wait(max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            log.error("fleet: replica %d did not drain; killing", r.rid)
+            r.proc.kill()
+            bad += 1
+            continue
+        if rc != 0 and r.healthy:
+            # An already-ejected replica (SIGKILLed, crashed) has told
+            # its story; only a LIVE replica failing its drain is news.
+            log.error("fleet: replica %d drain exited rc=%d", r.rid, rc)
+            bad += 1
+        r.pool.close_all()
+    return bad
+
+
+# ---------------------------------------------------------------- CLI
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="dwt-fleet: N dwt-serve replicas sharing one "
+        "ckpt_dir watch behind a least-outstanding-requests load "
+        "balancer",
+        epilog="All arguments after '--' are passed through to every "
+        "replica's dwt-serve (e.g. dwt-fleet --replicas 2 -- "
+        "--ckpt_dir runs/x --model lenet --watch).",
+    )
+    p.add_argument("--replicas", type=int, default=2,
+                   help="serving replica subprocesses to spawn")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8979,
+                   help="balancer port (0 = ephemeral)")
+    p.add_argument("--health_interval_s", type=float, default=1.0,
+                   help="per-replica /healthz probe period")
+    p.add_argument("--max_heartbeat_age_s", type=float, default=30.0,
+                   help="eject a replica whose dispatcher heartbeat age "
+                        "exceeds this while work is queued (wedged-but-"
+                        "listening)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        own, serve_argv = argv[:split], argv[split + 1:]
+    else:
+        own, serve_argv = argv, []
+    args = build_parser().parse_args(own)
+    if args.replicas < 1:
+        raise SystemExit("dwt-fleet: need at least one replica")
+
+    replicas = []
+    try:
+        for rid in range(args.replicas):
+            replicas.append(spawn_replica(rid, serve_argv, args.host))
+    except Exception:
+        for r in replicas:
+            if r.proc is not None:
+                r.proc.kill()
+        raise
+    rset = ReplicaSet(replicas)
+    prober = HealthProber(
+        rset, args.health_interval_s,
+        max_heartbeat_age_s=args.max_heartbeat_age_s,
+    )
+    prober.start()
+
+    draining = threading.Event()
+
+    def _handle(signum, frame):  # flag-only (resilience handler pattern)
+        draining.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _handle)
+
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = False
+
+    httpd = _Server(
+        (args.host, args.port), make_handler(rset, draining)
+    )
+    http_thread = threading.Thread(
+        target=httpd.serve_forever, name="dwt-fleet-http", daemon=True
+    )
+    http_thread.start()
+    print(json.dumps({
+        "kind": "fleet_ready",
+        "host": args.host, "port": httpd.server_address[1],
+        "replicas": [
+            {"rid": r.rid, "port": r.port, "pid": r.pid}
+            for r in replicas
+        ],
+    }), flush=True)
+
+    draining.wait()
+    log.info("fleet drain: SIGTERM/SIGINT received")
+    # Half-close order mirrors the single server: stop admitting (the
+    # handler answers 503 + Retry-After), stop health probes (a replica
+    # mid-drain answering nothing is not a health event), drain every
+    # replica's own queue via ITS SIGTERM path, then stop the front end.
+    prober.stop()
+    bad = drain_fleet(replicas)
+    httpd.shutdown()
+    http_thread.join(timeout=10)
+    httpd.server_close()
+    print(json.dumps({
+        "kind": "fleet_summary",
+        "replicas": rset.describe(),
+        "unclean_drains": bad,
+    }), flush=True)
+    return 0 if bad == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

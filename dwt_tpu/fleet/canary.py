@@ -1,0 +1,214 @@
+"""Canary gate + post-swap rollback verdicts: serving's DivergenceGuard.
+
+Training refuses to checkpoint non-finite params and the
+``DivergenceGuard`` rolls a diverged run back to the last good step; the
+fleet applies the same philosophy at the serve boundary, in two stages:
+
+* **pre-swap** (:class:`CanaryGate`): every candidate runs a fixture
+  eval — the deployment forward itself (``ServeEngine.infer`` with the
+  CANDIDATE state pinned, never swapped live) on a held-out batch —
+  before it can go live.  Non-finite logits, a forward that raises
+  (wrong dtype/structure past the adapt-time checks), or a fixture
+  accuracy regressed more than ``max_regress_pp`` below the live
+  version's refuse the candidate.  A digest-corrupt artifact never
+  reaches the gate: ``restore_tree`` re-verifies the manifest digest
+  and the reloader converts that failure into a refusal.
+* **post-swap** (:class:`PostSwapMonitor`): the serving-side divergence
+  signal is the access log's per-version windows (the ``version`` stamp
+  every record carries).  After a swap, once the new version has served
+  a minimum window, an error rate above threshold or a p99 blown past
+  ``p99_factor`` × the pre-swap baseline triggers rollback to the
+  last-good state (the previous :class:`~dwt_tpu.serve.engine
+  .EngineState`, kept device-resident exactly for this).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from dwt_tpu import obs
+from dwt_tpu.serve.engine import EngineState, ServeEngine
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class CanaryVerdict:
+    ok: bool
+    reason: str
+    metrics: dict = field(default_factory=dict)
+
+
+class CanaryGate:
+    """Fixture eval on a candidate state, compared against the live one.
+
+    ``fixture_x``: ``[n, ...sample]`` held-out batch (n ≤ the engine's
+    largest bucket); ``fixture_y`` (optional) enables the accuracy
+    regression check — without labels the gate still catches non-finite
+    and non-running candidates.  The live baseline re-evaluates lazily
+    per live version (a swap moves the bar the next candidate is held
+    to)."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        fixture_x: np.ndarray,
+        fixture_y: Optional[np.ndarray] = None,
+        max_regress_pp: float = 5.0,
+    ):
+        self.engine = engine
+        self.fixture_x = np.asarray(fixture_x, engine.input_dtype)
+        if self.fixture_x.shape[0] > engine.buckets[-1]:
+            # One compiled dispatch per canary check: the fixture must
+            # fit the largest bucket (split fixtures would complicate
+            # the accuracy bar for no gate-quality gain).
+            self.fixture_x = self.fixture_x[: engine.buckets[-1]]
+            fixture_y = (
+                None if fixture_y is None
+                else np.asarray(fixture_y)[: engine.buckets[-1]]
+            )
+        self.fixture_y = None if fixture_y is None else np.asarray(fixture_y)
+        self.max_regress_pp = float(max_regress_pp)
+        self._baseline_version = None
+        self._baseline_acc: Optional[float] = None
+
+    def _fixture_metrics(self, state: Optional[EngineState]) -> dict:
+        logits = self.engine.infer(self.fixture_x, state=state)
+        out = {"finite": bool(np.isfinite(logits).all())}
+        if self.fixture_y is not None:
+            out["accuracy"] = round(float(
+                100.0 * (np.argmax(logits, -1) == self.fixture_y).mean()
+            ), 4)
+        return out
+
+    def baseline(self) -> Optional[float]:
+        """Live version's fixture accuracy (None without labels),
+        re-measured when the live version changes."""
+        if self.fixture_y is None:
+            return None
+        live = self.engine.version
+        if self._baseline_version != live.label:
+            self._baseline_acc = self._fixture_metrics(None)["accuracy"]
+            self._baseline_version = live.label
+        return self._baseline_acc
+
+    def check(self, candidate: EngineState) -> CanaryVerdict:
+        """Gate one built candidate state; NEVER swaps it live."""
+        with obs.span("canary", "fleet", version=candidate.version.label):
+            try:
+                metrics = self._fixture_metrics(candidate)
+            except Exception as e:
+                return CanaryVerdict(
+                    False, f"fixture eval raised {type(e).__name__}: {e}"
+                )
+            if not metrics["finite"]:
+                return CanaryVerdict(
+                    False, "non-finite logits on the fixture batch",
+                    metrics,
+                )
+            base = self.baseline()
+            if base is not None:
+                metrics["baseline_accuracy"] = base
+                if metrics["accuracy"] < base - self.max_regress_pp:
+                    return CanaryVerdict(
+                        False,
+                        f"fixture accuracy {metrics['accuracy']:.2f} "
+                        f"regressed more than {self.max_regress_pp} pp "
+                        f"below live {base:.2f}",
+                        metrics,
+                    )
+            return CanaryVerdict(True, "ok", metrics)
+
+
+class PostSwapMonitor:
+    """Rollback verdicts off the per-version access-log windows.
+
+    Armed at swap time with the new version's label and the pre-swap
+    baseline p99 (the OLD version's window — measured under the same
+    traffic the new version inherits).  ``verdict()`` returns:
+
+    * ``None`` — undecided (window too small, still inside the grace
+      period);
+    * ``"ok"`` — the new version held: window served clean;
+    * ``"rollback: …"`` — error rate or p99 regressed past threshold.
+
+    ``clock`` is injectable (fake-clock tests, the repo convention).
+    """
+
+    def __init__(
+        self,
+        access_log,
+        *,
+        error_rate_threshold: float = 0.1,
+        p99_factor: float = 3.0,
+        min_requests: int = 50,
+        decide_after_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.access_log = access_log
+        self.error_rate_threshold = float(error_rate_threshold)
+        self.p99_factor = float(p99_factor)
+        self.min_requests = int(min_requests)
+        self.decide_after_s = float(decide_after_s)
+        self._clock = clock
+        self._armed = False
+        self._version: Optional[str] = None
+        self._baseline_p99: Optional[float] = None
+        self._t_swap: Optional[float] = None
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def arm(self, version: str,
+            baseline_p99: Optional[float] = None) -> None:
+        self._armed = True
+        self._version = str(version)
+        self._baseline_p99 = baseline_p99
+        self._t_swap = self._clock()
+
+    def disarm(self) -> None:
+        self._armed = False
+        self._version = None
+
+    def verdict(self) -> Optional[str]:
+        if not self._armed:
+            return None
+        stats = self.access_log.version_stats(self._version)
+        total = stats.get("served", 0) + stats.get("errors", 0)
+        # Errors are a fast trip: even a small all-errors window is a
+        # clear regression — don't wait out the grace period serving 500s.
+        if (total >= max(8, self.min_requests // 4)
+                and stats.get("error_rate", 0.0)
+                > self.error_rate_threshold):
+            return (
+                f"rollback: error_rate {stats['error_rate']:.3f} > "
+                f"{self.error_rate_threshold} over {total} requests"
+            )
+        if total < self.min_requests:
+            if (self._clock() - self._t_swap) >= self.decide_after_s:
+                # Grace period over with a thin window and no error
+                # trip: hold the version (an idle server must not be
+                # forced back forever).
+                return "ok"
+            return None
+        if (self._baseline_p99 is not None
+                and stats.get("e2e_ms_p99") is not None
+                and stats["e2e_ms_p99"]
+                > self.p99_factor * self._baseline_p99):
+            return (
+                f"rollback: e2e p99 {stats['e2e_ms_p99']:.1f} ms > "
+                f"{self.p99_factor}x baseline "
+                f"{self._baseline_p99:.1f} ms"
+            )
+        if stats.get("error_rate", 0.0) > self.error_rate_threshold:
+            return (
+                f"rollback: error_rate {stats['error_rate']:.3f} > "
+                f"{self.error_rate_threshold}"
+            )
+        return "ok"

@@ -1,0 +1,247 @@
+"""Hot reload: watch → restore → canary → atomic swap → monitor → rollback.
+
+The :class:`HotReloader` owns the whole continuous-deployment lifecycle
+for ONE serving process.  Everything expensive — the loose checkpoint
+read, the structural graft onto the model template, the whiten-cache
+factorization, the device placement through the sharding plan — runs on
+the reloader's own thread while the dispatcher keeps serving the live
+generation (the double buffer); only the final pointer flip
+(``ServeEngine.swap``) touches the serving path, and that flip is a
+single reference assignment between dispatches.
+
+Failure containment mirrors the training guard ladder:
+
+* a candidate that fails to RESTORE (torn bytes, digest mismatch —
+  ``restore_tree`` re-verifies the manifest digest) or to BUILD
+  (structure/shape mismatch at ``adapt_tree``) is refused and
+  remembered, so the watcher re-seeing the same artifact does not retry
+  it forever;
+* a candidate the :class:`~dwt_tpu.fleet.canary.CanaryGate` refuses
+  (non-finite / regressed fixture eval) likewise never goes live;
+* a candidate that goes live but regresses the post-swap access-log
+  windows (:class:`~dwt_tpu.fleet.canary.PostSwapMonitor`) is rolled
+  back to the last-good state — kept device-resident since the swap —
+  and blacklisted.
+
+Every transition writes a JSONL event (``reload``/``canary``/``swap``/
+``rollback``) through the access log, version-labelled, so one file
+tells the deployment story next to the requests it affected.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from dwt_tpu import obs
+from dwt_tpu.fleet.canary import CanaryGate, PostSwapMonitor
+from dwt_tpu.fleet.watcher import Candidate, CheckpointWatcher, newest_candidate
+from dwt_tpu.serve.engine import EngineState, ServeEngine, Version
+from dwt_tpu.utils.checkpoint import restore_tree
+
+log = logging.getLogger(__name__)
+
+
+class HotReloader:
+    """One serving process's continuous-deployment loop.
+
+    ``step()`` is the single-iteration core (poll → maybe deploy → maybe
+    roll back) — unit-testable with no thread; ``start()``/``stop()``
+    wrap it in a daemon.  ``reload_newest(force=True)`` is the bench's
+    direct lever: swap the newest checkpoint in NOW (even if it is the
+    version already live — a same-checkpoint swap is the numeric no-op
+    the parity tests pin).
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        ckpt_dir: str,
+        *,
+        access_log=None,
+        poll_s: float = 2.0,
+        canary: Optional[CanaryGate] = None,
+        monitor: Optional[PostSwapMonitor] = None,
+    ):
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.access_log = access_log
+        self.canary = canary
+        self.monitor = monitor
+        self.watcher = CheckpointWatcher(ckpt_dir, poll_s)
+        # The version the server booted with must not redeploy on the
+        # first poll: prime the watcher with it when it IS the newest.
+        boot = newest_candidate(ckpt_dir)
+        if boot is not None and self._is_live(boot):
+            self.watcher.prime(boot)
+        self.rejected: dict = {}     # version key -> refusal reason
+        self.last_good: Optional[EngineState] = None
+        self._last_good_label: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.swap_count = 0
+        self.rollback_count = 0
+
+    def _is_live(self, cand: Candidate) -> bool:
+        """Is this candidate the generation already serving?  Digest
+        first — it is the content identity and identical whether it came
+        from the manifest or was recomputed over the restored params;
+        the step number alone can differ between a checkpoint's
+        directory name and the train state it holds (legacy manifests
+        without a digest fall back to the step)."""
+        live = self.engine.version
+        if cand.digest is not None and live.digest is not None:
+            return cand.digest == live.digest
+        return cand.step == live.step
+
+    # ------------------------------------------------------------- events
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.access_log is not None:
+            self.access_log.event(kind, **fields)
+
+    def _reject(self, cand_key, label: str, reason: str) -> None:
+        self.rejected[cand_key] = reason
+        log.warning("fleet: candidate %s refused: %s", label, reason)
+        self._event("canary", version=label, ok=False, reason=reason)
+
+    # ------------------------------------------------------------ deploy
+
+    def _build_candidate(self, cand: Candidate) -> EngineState:
+        with obs.span("reload_restore", "fleet", step=cand.step):
+            tree = restore_tree(cand.path)  # digest re-verified here
+        return self.engine.build_state_from_tree(
+            tree,
+            version=Version(cand.step, cand.digest),
+            what=f"candidate step {cand.step}",
+        )
+
+    def deploy(self, cand: Candidate, *, skip_canary: bool = False) -> bool:
+        """Restore → build → canary → swap one candidate.  Returns True
+        when the candidate went live."""
+        label = Version(cand.step, cand.digest).label
+        self._event("reload", version=label, step=cand.step,
+                    source=cand.source)
+        try:
+            state = self._build_candidate(cand)
+        except Exception as e:
+            self._reject(cand.key, label,
+                         f"restore/build failed: {type(e).__name__}: {e}")
+            return False
+        label = state.version.label  # digest may have been computed late
+        if self.canary is not None and not skip_canary:
+            # Measure the live baseline BEFORE the swap moves it.
+            verdict = self.canary.check(state)
+            self._event("canary", version=label, ok=verdict.ok,
+                        reason=verdict.reason, **verdict.metrics)
+            if not verdict.ok:
+                self._reject(cand.key, label, verdict.reason)
+                return False
+        old_label = self.engine.version.label
+        baseline_p99 = None
+        if self.access_log is not None:
+            baseline_p99 = self.access_log.version_stats(old_label).get(
+                "e2e_ms_p99"
+            )
+        with obs.span("swap", "fleet", version=label):
+            prev = self.engine.swap(state)
+        self.swap_count += 1
+        self.last_good = prev
+        self._last_good_label = old_label
+        self._event("swap", version=label, from_version=old_label,
+                    step=cand.step)
+        if self.monitor is not None:
+            self.monitor.arm(label, baseline_p99)
+        return True
+
+    def rollback(self, reason: str) -> bool:
+        """Swap the last-good state back in and blacklist the regressed
+        version.  Returns False when there is nothing to roll back to
+        (first deploy of a fresh server — keep serving, keep alarming)."""
+        bad = self.engine.version
+        if self.last_good is None:
+            log.error(
+                "fleet: %s but no last-good state to roll back to "
+                "(version %s stays live)", reason, bad.label,
+            )
+            self._event("rollback", version=bad.label, ok=False,
+                        reason=reason)
+            return False
+        with obs.span("swap", "fleet", version=self.last_good.version.label,
+                      rollback=1):
+            self.engine.swap(self.last_good)
+        self.rollback_count += 1
+        self.rejected[(bad.step, bad.digest)] = reason
+        self._event("rollback", version=bad.label,
+                    to_version=self.last_good.version.label,
+                    reason=reason)
+        log.warning(
+            "fleet: rolled back %s -> %s (%s)",
+            bad.label, self.last_good.version.label, reason,
+        )
+        # The rolled-back-to state is live again; nothing newer is good.
+        self.last_good = None
+        if self.monitor is not None:
+            self.monitor.disarm()
+        return True
+
+    def reload_newest(self, *, force: bool = False,
+                      skip_canary: bool = False) -> bool:
+        """Deploy the newest valid checkpoint directly (bench/ops lever).
+        ``force`` redeploys even the live version (a same-checkpoint
+        swap: numerically a no-op, operationally the swap-cost probe)."""
+        cand = newest_candidate(self.ckpt_dir)
+        if cand is None:
+            return False
+        if not force and self._is_live(cand):
+            return False
+        return self.deploy(cand, skip_canary=skip_canary)
+
+    # -------------------------------------------------------------- loop
+
+    def step(self) -> None:
+        """One reloader iteration: act on a monitor verdict, then on a
+        new candidate.  Rollback first — deploying on top of a regressed
+        version would destroy the evidence."""
+        if self.monitor is not None and self.monitor.armed:
+            verdict = self.monitor.verdict()
+            if verdict is None:
+                return  # undecided: hold new deploys until the window fills
+            if verdict.startswith("rollback"):
+                self.rollback(verdict)
+                return
+            self.monitor.disarm()  # "ok": the new version is the bar now
+        cand = self.watcher.poll_once()
+        if cand is None:
+            return
+        if cand.key in self.rejected:
+            log.info(
+                "fleet: skipping already-refused candidate step %s (%s)",
+                cand.step, self.rejected[cand.key],
+            )
+            return
+        self.deploy(cand)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("reloader already started")
+
+        def _run():
+            while not self._stop.wait(self.watcher.poll_s):
+                try:
+                    self.step()
+                except Exception:
+                    log.exception("fleet: reloader step failed")
+
+        self._thread = threading.Thread(
+            target=_run, name="dwt-fleet-reload", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.watcher.stop()

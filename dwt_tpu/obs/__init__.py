@@ -24,6 +24,10 @@ Span categories (the report tool groups by these):
 * ``serve`` — serving path (admission → plan → build → stage → device →
   resolve), spans carrying ``bucket``/``req_id`` attrs that correlate
   with ``AccessLog`` records.
+* ``fleet`` — continuous-deployment lifecycle (reload_restore →
+  build_state → canary → swap), version-attributed; joins the
+  ``reload``/``canary``/``swap``/``rollback`` JSONL events and the
+  per-version access windows.
 * ``detail`` — nested sub-phases (guard check, consensus decide) inside
   a ``step`` span; excluded from the top-level sum.
 """

@@ -20,9 +20,9 @@ from dwt_tpu.serve.batcher import (
     bucket_for,
     plan_dispatch,
 )
-from dwt_tpu.serve.engine import ServeEngine
+from dwt_tpu.serve.engine import EngineState, ServeEngine, Version
 from dwt_tpu.serve.metrics import AccessLog
-from dwt_tpu.serve.server import ServeClient
+from dwt_tpu.serve.server import HttpServeClient, ServeClient
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -32,7 +32,10 @@ __all__ = [
     "ShedError",
     "bucket_for",
     "plan_dispatch",
+    "EngineState",
     "ServeEngine",
+    "Version",
     "AccessLog",
+    "HttpServeClient",
     "ServeClient",
 ]

@@ -143,6 +143,7 @@ def plan_dispatch(
     now: float,
     oldest_t: Optional[float],
     max_delay_s: float,
+    max_share: float = 1.0,
 ) -> int:
     """How many queued requests to dispatch NOW (0 = keep waiting).
 
@@ -158,24 +159,47 @@ def plan_dispatch(
     * the oldest request has waited ``max_delay_s``.
 
     Otherwise return 0 and let the caller sleep until the deadline.
+
+    **Fairness cap** (``max_share`` < 1): a single request may occupy at
+    most ``max_share`` of the largest bucket when sharing a batch.  A
+    request past the cap is a SOLO rider — it dispatches alone in its
+    own smallest-fitting bucket and never coalesces with neighbors, so
+    one giant request can no longer drag small requests into (or make
+    them wait behind) a largest-bucket dispatch whose device time blows
+    their deadline: the smalls ride their own small, fast bucket in the
+    immediately following plan.  ``max_share=1`` is bitwise the legacy
+    rule (the cap equals the largest bucket, which admission already
+    enforces per request).
     """
     if not queued_ns:
         return 0
     largest = buckets[-1]
-    take, total = 0, 0
-    for n in queued_ns:
-        if total + n > largest:
-            break
-        take += 1
-        total += n
-    if take == 0:
-        # First request alone exceeds the largest bucket — admission
-        # should have rejected it; dispatching nothing forever would
-        # wedge the queue, so fail loudly.
+    cap = largest if max_share >= 1.0 else max(1, int(largest * max_share))
+    if queued_ns[0] > largest:
+        # Admission should have rejected it; dispatching nothing forever
+        # would wedge the queue, so fail loudly.
         raise ValueError(
             f"queued request of {queued_ns[0]} samples exceeds the "
             f"largest bucket {largest}"
         )
+    if queued_ns[0] > cap:
+        # Solo giant at the head: nothing may ride with it.  Dispatch it
+        # NOW when anyone is waiting behind it (they must not queue
+        # through its deadline), when it fills the largest bucket, or at
+        # its own deadline.
+        if (len(queued_ns) > 1 or queued_ns[0] == largest
+                or (oldest_t is not None
+                    and now - oldest_t >= max_delay_s)):
+            return 1
+        return 0
+    take, total = 0, 0
+    for n in queued_ns:
+        if n > cap or total + n > largest:
+            # A solo giant mid-prefix ends the batch before it (the
+            # smalls ahead dispatch now via the take < len rule below).
+            break
+        take += 1
+        total += n
     if total == largest or take < len(queued_ns):
         return take
     if oldest_t is not None and now - oldest_t >= max_delay_s:
@@ -199,7 +223,13 @@ class MicroBatcher:
         max_queue_items: int = 1024,
         clock: Callable[[], float] = time.monotonic,
         sample_shape: Optional[Tuple[int, ...]] = None,
+        max_request_share: float = 1.0,
     ):
+        if not 0.0 < max_request_share <= 1.0:
+            raise ValueError(
+                f"max_request_share must be in (0, 1], got "
+                f"{max_request_share!r}"
+            )
         if not buckets or list(buckets) != sorted(set(int(b) for b in buckets)):
             raise ValueError(
                 f"buckets must be distinct ascending sizes, got {buckets!r}"
@@ -215,6 +245,7 @@ class MicroBatcher:
         )
         self.max_delay_s = float(max_batch_delay_ms) / 1e3
         self.max_queue_items = int(max_queue_items)
+        self.max_request_share = float(max_request_share)
         self._clock = clock
         self._cond = threading.Condition()
         self._queue: List[_Request] = []
@@ -324,6 +355,7 @@ class MicroBatcher:
             # Drain mode: no deadline games — a zero deadline flushes the
             # order-preserving prefix immediately (same rule, same code).
             0.0 if self._draining else self.max_delay_s,
+            self.max_request_share,
         )
 
     def _pop_locked(self, take: int) -> List[_Request]:

@@ -25,24 +25,71 @@ makes that forward servable:
   and the logits D2H.  The host-array loose restore plus plan placement
   is serve's restore-to-spec: each leaf lands directly on its target
   sharding, no replicated device intermediate.
+
+**Hot swap (the continuous-deployment fleet, ``dwt_tpu.fleet``).**  The
+weights the compiled bucket executables close over are NOT baked into
+the executables — params/stats/cache are arguments — so a new
+checkpoint's trees, built into a fresh :class:`EngineState` off the
+dispatcher thread (:meth:`ServeEngine.build_state`: same adapt → cache
+factorization → plan placement path as load), swap in as one atomic
+pointer flip (:meth:`ServeEngine.swap`).  The dispatcher snapshots the
+state ONCE per batch, so an in-flight bucket finishes on the version it
+started with and no batch ever mixes versions; the old state is
+returned to the caller (the fleet's rollback buffer).
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from dwt_tpu import obs
 from dwt_tpu.serve.batcher import DEFAULT_BUCKETS, bucket_for, pad_to_bucket
 from dwt_tpu.train.evalpipe import make_whiten_cache_fn
 from dwt_tpu.train.steps import make_serve_forward
 from dwt_tpu.utils import restore_newest
-from dwt_tpu.utils.checkpoint import adapt_tree
+from dwt_tpu.utils.checkpoint import adapt_tree, params_digest
 
 log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Version:
+    """Identity of the weights a response was computed with: checkpoint
+    step + short params digest.  Stamped into every access record and
+    ``/stats`` so post-swap latency/error windows are attributable to
+    the version that served them — the signal the canary rollback reads.
+    A fresh-init engine has no checkpoint identity (``label`` =
+    ``"fresh"``)."""
+
+    step: Optional[int] = None
+    digest: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        if self.step is None and self.digest is None:
+            return "fresh"
+        d = (self.digest or "nodigest")[:8]
+        return f"{self.step}-{d}"
+
+
+class EngineState(NamedTuple):
+    """One immutable generation of device-resident serving weights.
+
+    The whole deployment artifact — params, frozen whitening/BN running
+    stats, and the whiten cache precomputed from them — travels as ONE
+    value, so a swap can never pair new params with an old cache (a torn
+    mixed-generation forward would break the bitwise eval contract)."""
+
+    params: Any
+    batch_stats: Any
+    cache: Any
+    version: Version
 
 
 class ServeEngine:
@@ -74,6 +121,7 @@ class ServeEngine:
         input_dtype=np.float32,
         step: Optional[int] = None,
         source: Optional[str] = None,
+        digest: Optional[str] = None,
     ):
         if plan is None:
             from dwt_tpu.parallel import ShardingPlan
@@ -82,7 +130,6 @@ class ServeEngine:
         self.model = model
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
-        self.step = step          # checkpoint step served (None: fresh init)
         self.source = source      # "checkpoint" | "anchor" | None
         self._plan = plan
         self._mesh = plan.mesh
@@ -108,34 +155,22 @@ class ServeEngine:
             # with a different eps than the model's in-site path would
             # break the bitwise contract with the uncached eval forward.
             whiten_eps = getattr(model, "whiten_eps", 1e-3)
-        cache = make_whiten_cache_fn(whitener, whiten_eps, eval_domain)(
-            batch_stats
+        # Kept so hot-swapped candidates factorize their cache with the
+        # SAME compiled builder + numerics the initial load used.
+        self._cache_fn = make_whiten_cache_fn(
+            whitener, whiten_eps, eval_domain
+        )
+        self.swap_count = 0
+        self._state = self.build_state(
+            params, batch_stats, version=Version(step, digest)
         )
         forward = make_serve_forward(model)
         self._x_sharding = plan.batch_sharding()
         fwd = plan.make_serve_forward(forward)
-        # Device residency: the ONE placement of the run, through the
-        # plan.  gspmd places params per the rules table (stats and the
-        # cache pin replicated via the preset's contract); single/replica
-        # replicate everything — today's paths.  Host arrays land
-        # DIRECTLY on their target shardings: serve's restore-to-spec.
-        if plan.mode == "gspmd":
-            placed = plan.place(
-                {"params": params, "batch_stats": batch_stats,
-                 "whiten_cache": cache},
-                "serve state",
-            )
-            self.params = placed["params"]
-            self.batch_stats = placed["batch_stats"]
-            self.cache = placed["whiten_cache"] if cache else cache
-        else:
-            self.params = plan.place_replicated(params)
-            self.batch_stats = plan.place_replicated(batch_stats)
-            self.cache = plan.place_replicated(cache) if cache else cache
-
         self._compiled: Dict[int, object] = {}
         self.compile_s: Dict[int, float] = {}
         jitted = jax.jit(fwd)
+        st = self._state
         for b in self.buckets:
             spec = jax.ShapeDtypeStruct(
                 (b,) + self.input_shape, self.input_dtype,
@@ -143,13 +178,117 @@ class ServeEngine:
             )
             t0 = time.perf_counter()
             self._compiled[b] = jitted.lower(
-                self.params, self.batch_stats, self.cache, spec
+                st.params, st.batch_stats, st.cache, spec
             ).compile()
             self.compile_s[b] = round(time.perf_counter() - t0, 3)
         log.info(
-            "serve engine ready: buckets %s compiled in %s s (step=%s)",
-            self.buckets, self.compile_s, step,
+            "serve engine ready: buckets %s compiled in %s s (version=%s)",
+            self.buckets, self.compile_s, st.version.label,
         )
+
+    # ------------------------------------------------------ state / versions
+
+    @property
+    def state(self) -> EngineState:
+        """The live generation — snapshot this ONCE per batch; everything
+        computed from one snapshot is single-version by construction."""
+        return self._state
+
+    @property
+    def version(self) -> Version:
+        return self._state.version
+
+    @property
+    def params(self):
+        return self._state.params
+
+    @property
+    def batch_stats(self):
+        return self._state.batch_stats
+
+    @property
+    def cache(self):
+        return self._state.cache
+
+    @property
+    def step(self) -> Optional[int]:
+        return self._state.version.step
+
+    def build_state(
+        self, params, batch_stats, *, version: Optional[Version] = None
+    ) -> EngineState:
+        """Build one swappable generation: factorize the whiten cache
+        from the frozen stats and place everything per the plan — the
+        restore-to-spec placement path (host leaves land directly on
+        their target shardings).  Safe to run OFF the dispatcher thread:
+        nothing here touches the live ``_state``, so serving continues
+        on the old generation while the new one builds (the double
+        buffer)."""
+        with obs.span("build_state", "fleet",
+                      version=version.label if version else "fresh"):
+            cache = self._cache_fn(batch_stats)
+            plan = self._plan
+            if plan.mode == "gspmd":
+                placed = plan.place(
+                    {"params": params, "batch_stats": batch_stats,
+                     "whiten_cache": cache},
+                    "serve state",
+                )
+                params = placed["params"]
+                batch_stats = placed["batch_stats"]
+                cache = placed["whiten_cache"] if cache else cache
+            else:
+                params = plan.place_replicated(params)
+                batch_stats = plan.place_replicated(batch_stats)
+                cache = plan.place_replicated(cache) if cache else cache
+        return EngineState(params, batch_stats, cache,
+                           version or Version())
+
+    def build_state_from_tree(
+        self, tree: dict, *, version: Optional[Version] = None,
+        what: str = "candidate",
+    ) -> EngineState:
+        """Loose checkpoint tree (``restore_tree`` output) → swappable
+        generation: graft params/stats onto the model's typed template
+        (structural validation — a candidate from a different
+        architecture fails HERE, not at forward time), then
+        :meth:`build_state`."""
+        if not isinstance(tree, dict) or "params" not in tree \
+                or "batch_stats" not in tree:
+            raise ValueError(
+                f"{what}: restored tree has no params/batch_stats — "
+                "not a TrainState artifact"
+            )
+        template = abstract_variables(self.model, self.input_shape)
+        params = adapt_tree(
+            tree["params"], template["params"], f"{what} params"
+        )
+        batch_stats = adapt_tree(
+            tree["batch_stats"], template["batch_stats"],
+            f"{what} batch_stats",
+        )
+        if version is None:
+            step = tree.get("step")
+            version = Version(
+                None if step is None else int(np.asarray(step)),
+                params_digest(params),
+            )
+        return self.build_state(params, batch_stats, version=version)
+
+    def swap(self, state: EngineState) -> EngineState:
+        """Atomic generation flip; returns the PREVIOUS state (the
+        fleet keeps it as the rollback buffer).  The single reference
+        assignment is the whole cutover: batches whose snapshot predates
+        it finish on the old generation, the next snapshot serves the
+        new one — no lock, no pause, no torn mixed-version batch."""
+        prev = self._state
+        self._state = state
+        self.swap_count += 1
+        log.info(
+            "serve engine swapped: %s -> %s (swap #%d)",
+            prev.version.label, state.version.label, self.swap_count,
+        )
+        return prev
 
     # -------------------------------------------------------------- loading
 
@@ -182,15 +321,7 @@ class ServeEngine:
                 f"checkpoint under {ckpt_dir} restored without params/"
                 "batch_stats — not a TrainState artifact"
             )
-        import jax.numpy as jnp
-
-        num_domains = getattr(model, "num_domains", 2)
-        sample = jnp.zeros(
-            (num_domains, 1) + tuple(input_shape), jnp.float32
-        )
-        variables = jax.eval_shape(
-            lambda: model.init(jax.random.key(0), sample, train=True)
-        )
+        variables = abstract_variables(model, input_shape)
         params = adapt_tree(
             tree["params"], variables["params"], f"{ckpt_dir} params"
         )
@@ -203,6 +334,10 @@ class ServeEngine:
             model, params, batch_stats, input_shape,
             step=None if step is None else int(np.asarray(step)),
             source=source,
+            # The version digest is the restore-verified params digest,
+            # recomputed host-side (also covers manifest-less legacy
+            # artifacts, which record none).
+            digest=params_digest(params),
             **kwargs,
         )
 
@@ -217,24 +352,32 @@ class ServeEngine:
             return jax.device_put(x)
         return jax.device_put(x, self._x_sharding)
 
-    def forward(self, x_staged, bucket: int):
-        """Compiled forward of one staged bucket batch -> device logits."""
+    def forward(self, x_staged, bucket: int,
+                state: Optional[EngineState] = None):
+        """Compiled forward of one staged bucket batch -> device logits.
+
+        ``state`` pins the generation (the dispatcher passes its
+        per-batch snapshot; the canary passes a candidate under test);
+        default is the live state."""
         fn = self._compiled.get(int(bucket))
         if fn is None:
             raise ValueError(
                 f"no compiled forward for bucket {bucket} "
                 f"(compiled: {self.buckets})"
             )
-        return fn(self.params, self.batch_stats, self.cache, x_staged)
+        st = state if state is not None else self._state
+        return fn(st.params, st.batch_stats, st.cache, x_staged)
 
-    def infer(self, x: np.ndarray, bucket: Optional[int] = None) -> np.ndarray:
+    def infer(self, x: np.ndarray, bucket: Optional[int] = None,
+              state: Optional[EngineState] = None) -> np.ndarray:
         """Convenience synchronous path: pad → stage → forward → fetch.
 
         ``x`` is ``[n, ...sample]`` with ``n`` ≤ the largest bucket;
         returns the ``[n, classes]`` logits for the REAL rows only.  The
         server's batched path does these stages on separate threads; this
-        single-call form serves tests and the in-process client's
-        unbatched mode.
+        single-call form serves tests, the in-process client's unbatched
+        mode, and the canary gate's fixture eval (which passes a
+        CANDIDATE ``state`` without swapping it live).
         """
         x = np.asarray(x, self.input_dtype)
         n = x.shape[0]
@@ -243,6 +386,22 @@ class ServeEngine:
         elif n < 1 or n > bucket:
             raise ValueError(f"got {n} samples for bucket {bucket}")
         logits = jax.device_get(
-            self.forward(self.stage(pad_to_bucket(x, bucket)), bucket)
+            self.forward(self.stage(pad_to_bucket(x, bucket)), bucket,
+                         state=state)
         )
         return np.asarray(logits)[:n]
+
+
+def abstract_variables(model, input_shape: Tuple[int, ...]) -> Any:
+    """Shape-only ``model.init`` template (``jax.eval_shape`` — no FLOPs,
+    no device memory): the typed structure loose checkpoint dicts graft
+    onto, shared by the initial load and every hot-reload candidate."""
+    import jax.numpy as jnp
+
+    num_domains = getattr(model, "num_domains", 2)
+    sample = jnp.zeros(
+        (num_domains, 1) + tuple(input_shape), jnp.float32
+    )
+    return jax.eval_shape(
+        lambda: model.init(jax.random.key(0), sample, train=True)
+    )

@@ -18,10 +18,20 @@ Access-record schema (all times milliseconds)::
      "queue_ms": 1.9,      # enqueue -> dispatch (admission + coalescing)
      "device_ms": 3.1,     # H2D-staged dispatch -> logits fetched
      "e2e_ms": 5.4,        # enqueue -> response ready
+     "version": "800-3f2a91bc",  # checkpoint step + short params digest
+     "batch_seq": 17,      # dispatcher batch counter (batch identity)
      "retry_after_ms": 50} # shed responses only
 
 ``queue_ms``/``device_ms`` are batch-level quantities stamped onto every
-request that rode the batch; ``e2e_ms`` is per-request.
+request that rode the batch; ``e2e_ms`` is per-request.  ``version`` and
+``batch_seq`` are the continuous-deployment fleet's audit trail: every
+record of one ``batch_seq`` must carry the SAME version (no
+mixed-version batch — asserted by tests), and per-version latency/error
+windows are what the canary's post-swap rollback reads.
+
+Fleet lifecycle events (``AccessLog.event``) ride the same JSONL stream
+with their own ``kind`` (``reload``/``canary``/``swap``/``rollback``) so
+one file tells the whole watch → canary → swap → rollback story.
 """
 
 from __future__ import annotations
@@ -41,6 +51,23 @@ log = logging.getLogger(__name__)
 # measured honestly without unbounded memory on a server that stays up
 # for days.
 _WINDOW = 100_000
+
+# Per-version latency windows are smaller (rollback verdicts read recent
+# behavior, not history) and the version map itself is bounded: a server
+# that hot-swaps for days must not grow a dict per superseded version.
+_VERSION_WINDOW = 10_000
+_MAX_VERSIONS = 8
+
+
+class _VersionStats:
+    """Per-served-version aggregates: the post-swap rollback signal."""
+
+    __slots__ = ("served", "errors", "e2e_ms")
+
+    def __init__(self):
+        self.served = 0
+        self.errors = 0
+        self.e2e_ms = collections.deque(maxlen=_VERSION_WINDOW)
 
 
 class AccessLog:
@@ -66,43 +93,107 @@ class AccessLog:
         self._e2e_ms = collections.deque(maxlen=_WINDOW)
         self._queue_ms = collections.deque(maxlen=_WINDOW)
         self._device_ms = collections.deque(maxlen=_WINDOW)
+        # Resolution stamps (seconds since construction, perf_counter
+        # clock), parallel to _e2e_ms: the serve bench slices latency
+        # windows around swap times with these — swap-window p99 vs
+        # steady-state needs to know WHEN each sample resolved.
+        self._resolved_t = collections.deque(maxlen=_WINDOW)
+        # Per-version windows, insertion-ordered so the oldest version
+        # falls off once the map is full.
+        self._versions: "collections.OrderedDict[str, _VersionStats]" = \
+            collections.OrderedDict()
         self._write_failed = False  # warn once, not per record
+
+    def _version_stats_locked(self, version: str) -> _VersionStats:
+        vs = self._versions.get(version)
+        if vs is None:
+            while len(self._versions) >= _MAX_VERSIONS:
+                self._versions.popitem(last=False)
+            vs = self._versions[version] = _VersionStats()
+        return vs
 
     def record(self, status: str, n: int, **fields) -> None:
         rec = {"kind": "access", "status": status, "n": int(n), **{
             k: (round(float(v), 3) if isinstance(v, float) else v)
             for k, v in fields.items()
         }}
+        version = fields.get("version")
         with self._lock:
             if status == "ok":
                 self.served_requests += 1
                 self.served_imgs += int(n)
                 if "e2e_ms" in fields:
                     self._e2e_ms.append(float(fields["e2e_ms"]))
+                    self._resolved_t.append(
+                        time.perf_counter() - self._t0
+                    )
                 if "queue_ms" in fields:
                     self._queue_ms.append(float(fields["queue_ms"]))
                 if "device_ms" in fields:
                     self._device_ms.append(float(fields["device_ms"]))
+                if version is not None:
+                    vs = self._version_stats_locked(str(version))
+                    vs.served += 1
+                    if "e2e_ms" in fields:
+                        vs.e2e_ms.append(float(fields["e2e_ms"]))
             elif status == "shed":
                 self.shed_requests += 1
             else:
                 self.error_requests += 1
-            # Logging is availability-decoupled: record() runs on the
-            # dispatcher thread, and a full disk must degrade to lost
-            # access records — not to a dead dispatcher that sheds all
-            # traffic while inference itself is healthy.
-            line = json.dumps(rec) + "\n"
-            for sink in (self._file, self._stream):
-                if sink is not None:
-                    try:
-                        sink.write(line)
-                    except (OSError, ValueError) as e:
-                        if not self._write_failed:
-                            self._write_failed = True
-                            log.warning(
-                                "access-log write failed (%s); further "
-                                "records may be lost", e,
-                            )
+                if version is not None:
+                    self._version_stats_locked(str(version)).errors += 1
+            self._write_locked(rec)
+
+    def event(self, kind: str, **fields) -> None:
+        """One fleet lifecycle record (``reload``/``canary``/``swap``/
+        ``rollback``…) on the same JSONL stream as the access records —
+        the audit trail a post-mortem reads alongside the per-version
+        latency windows."""
+        rec = {"kind": str(kind), **{
+            k: (round(float(v), 3) if isinstance(v, float) else v)
+            for k, v in fields.items()
+        }}
+        with self._lock:
+            self._write_locked(rec)
+
+    def _write_locked(self, rec: dict) -> None:
+        # Logging is availability-decoupled: record() runs on the
+        # dispatcher thread, and a full disk must degrade to lost
+        # access records — not to a dead dispatcher that sheds all
+        # traffic while inference itself is healthy.
+        line = json.dumps(rec) + "\n"
+        for sink in (self._file, self._stream):
+            if sink is not None:
+                try:
+                    sink.write(line)
+                except (OSError, ValueError) as e:
+                    if not self._write_failed:
+                        self._write_failed = True
+                        log.warning(
+                            "access-log write failed (%s); further "
+                            "records may be lost", e,
+                        )
+
+    def version_stats(self, version: str) -> dict:
+        """Aggregates attributed to ONE served version: the post-swap
+        window the canary's rollback verdict reads.  Empty dict when the
+        version has served nothing yet."""
+        with self._lock:
+            vs = self._versions.get(str(version))
+            if vs is None:
+                return {}
+            out = {
+                "served": vs.served,
+                "errors": vs.errors,
+                "error_rate": round(
+                    vs.errors / max(vs.served + vs.errors, 1), 4
+                ),
+            }
+            window = list(vs.e2e_ms)
+        out.update(percentile_summary(
+            window, (50.0, 99.0), prefix="e2e_ms_p"
+        ))
+        return out
 
     def summary(self) -> dict:
         """Aggregate view over the run (latencies over the bounded
@@ -128,10 +219,28 @@ class AccessLog:
                 ("queue_ms", list(self._queue_ms)),
                 ("device_ms", list(self._device_ms)),
             ]
+            version_windows = {
+                v: (vs.served, vs.errors, list(vs.e2e_ms))
+                for v, vs in self._versions.items()
+            }
         for name, window in windows:
             out.update(percentile_summary(
                 window, (50.0, 95.0, 99.0), prefix=f"{name}_p"
             ))
+        if version_windows:
+            out["versions"] = {
+                v: {
+                    "served": served,
+                    "errors": errors,
+                    "error_rate": round(
+                        errors / max(served + errors, 1), 4
+                    ),
+                    **percentile_summary(
+                        window, (50.0, 99.0), prefix="e2e_ms_p"
+                    ),
+                }
+                for v, (served, errors, window) in version_windows.items()
+            }
         return out
 
     def windows(self) -> dict:
@@ -140,14 +249,23 @@ class AccessLog:
         and one after each offered-load run and keeps the last
         ``served_after - served_before`` samples of each window — correct
         even after the bounded deques wrap (an index diff would not be),
-        so every sweep point reports only its OWN requests' tail."""
+        so every sweep point reports only its OWN requests' tail.
+        ``resolved_t`` (seconds since this log's construction, parallel
+        to ``e2e_ms``) lets the bench slice swap windows out of a run."""
         with self._lock:
             return {
                 "served_requests": self.served_requests,
                 "e2e_ms": list(self._e2e_ms),
                 "queue_ms": list(self._queue_ms),
                 "device_ms": list(self._device_ms),
+                "resolved_t": list(self._resolved_t),
             }
+
+    @property
+    def t0(self) -> float:
+        """perf_counter origin of ``resolved_t`` stamps (the bench
+        converts its swap times onto the same timebase)."""
+        return self._t0
 
     def flush(self) -> None:
         with self._lock:

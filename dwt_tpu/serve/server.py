@@ -24,13 +24,15 @@ arrivals shed with ``Retry-After``, exit code 0.
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import logging
+import select
 import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -87,6 +89,11 @@ class _Dispatcher(threading.Thread):
         import collections
 
         self._inflight = collections.deque()
+        # Batch identity for the access records: every record of one
+        # dispatched batch carries the same batch_seq, so "no batch ever
+        # mixed versions" is checkable from the log alone (group by
+        # batch_seq, assert one distinct version per group).
+        self._batch_seq = 0
 
     @property
     def heartbeat_age_s(self) -> float:
@@ -150,6 +157,15 @@ class _Dispatcher(threading.Thread):
         )
         try:
             for pb, x_dev in staged:
+                # ONE state snapshot per batch — the hot-swap contract.
+                # A swap landing mid-batch flips the engine's pointer,
+                # but this batch computes AND is attributed entirely on
+                # the generation it snapshotted: in-flight buckets
+                # finish on the old version, no batch mixes versions.
+                st = engine.state
+                version = st.version.label
+                self._batch_seq += 1
+                batch_seq = self._batch_seq
                 t_dev0 = time.perf_counter()
                 try:
                     # The one deliberate sync on this thread: device_get
@@ -159,13 +175,16 @@ class _Dispatcher(threading.Thread):
                     with obs.span("device", "serve", bucket=pb.bucket,
                                   n=pb.real_n):
                         logits = np.asarray(
-                            jax.device_get(engine.forward(x_dev, pb.bucket))
+                            jax.device_get(
+                                engine.forward(x_dev, pb.bucket, state=st)
+                            )
                         )
                 except Exception as e:  # resolve, don't strand waiters
                     for req in pb.requests:
                         self.access_log.record(
                             "error", req.n, bucket=pb.bucket,
                             req_id=req.req_id,
+                            version=version, batch_seq=batch_seq,
                             error=f"{type(e).__name__}: {e}",
                         )
                         resolve_future(req.future, exc=e)
@@ -186,6 +205,7 @@ class _Dispatcher(threading.Thread):
                             bucket=pb.bucket, batch_n=pb.bucket,
                             real_n=pb.real_n,
                             req_id=req.req_id,
+                            version=version, batch_seq=batch_seq,
                             queue_ms=(pb.dispatch_t - req.enqueue_t) * 1e3,
                             device_ms=device_ms,
                             e2e_ms=(now - req.enqueue_t) * 1e3,
@@ -249,6 +269,7 @@ class ServeClient:
         max_queue_items: int = 1024,
         access_log: Optional[AccessLog] = None,
         staging_depth: int = 2,
+        max_request_share: float = 1.0,
     ):
         self.engine = engine
         self.access_log = access_log or AccessLog()
@@ -260,6 +281,7 @@ class ServeClient:
             # 400 to ITS client, never a concatenate error inside the
             # dispatcher that would take down the whole batch.
             sample_shape=engine.input_shape,
+            max_request_share=max_request_share,
         )
         self._dispatcher = _Dispatcher(
             engine, self.batcher, self.access_log, staging_depth
@@ -291,6 +313,7 @@ class ServeClient:
         view (uptime, queue depth, in-flight batches, device memory when
         the backend reports it)."""
         out = self.access_log.summary()
+        version = getattr(self.engine, "version", None)
         out.update(
             uptime_s=round(time.monotonic() - self._t0, 3),
             queued_items=self.batcher.queued_items,
@@ -298,6 +321,9 @@ class ServeClient:
             dispatcher_heartbeat_age_s=round(
                 self.dispatcher_heartbeat_age_s, 3
             ),
+            **({"version": version.label,
+                "swap_count": getattr(self.engine, "swap_count", 0)}
+               if version is not None else {}),
         )
         mem = _device_memory_stats()
         if mem is not None:
@@ -342,19 +368,155 @@ def _device_memory_stats() -> Optional[dict]:
             if isinstance(v, (int, float))}
 
 
+class HttpServeClient:
+    """Keep-alive HTTP client for ``dwt-serve`` / ``dwt-fleet`` endpoints.
+
+    One persistent ``http.client.HTTPConnection`` per calling thread
+    (thread-local — the connection object is not thread-safe), reused
+    across requests: the serve bench and the load balancer previously
+    paid a fresh TCP connect per request, a per-request cost that scaled
+    with exactly the offered loads being measured.  A stale/broken
+    connection (server restarted, keep-alive timed out) is rebuilt once
+    per request before the error propagates.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 70.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self._local = threading.local()
+
+    def _conn(self, fresh: bool = False) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if fresh and conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+            self._local.conn = conn
+        return conn
+
+    def request_json(
+        self, method: str, path: str, payload: Optional[dict] = None,
+    ) -> Tuple[int, dict]:
+        """One request over the persistent connection → (status, body).
+        Retries ONCE on a dead kept-alive connection — but only when the
+        request never reached the server (connect/send failure), so a
+        non-idempotent ``/infer`` is never silently duplicated."""
+        body = None if payload is None else json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"} if body else {}
+        for attempt in (0, 1):
+            conn = self._conn(fresh=attempt > 0)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except (http.client.HTTPException, OSError):
+                if attempt:
+                    raise
+                continue  # send never completed: safe to rebuild + retry
+            try:
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError):
+                # The request may have executed server-side: surface the
+                # failure instead of re-sending it.
+                self._conn(fresh=True)
+                raise
+            try:
+                parsed = json.loads(data) if data else {}
+            except ValueError:
+                parsed = {"raw": data.decode(errors="replace")}
+            return resp.status, parsed
+        raise RuntimeError("unreachable")
+
+    def infer(self, x: np.ndarray) -> np.ndarray:
+        status, payload = self.request_json(
+            "POST", "/infer", {"inputs": np.asarray(x).tolist()}
+        )
+        if status == 200:
+            return np.asarray(payload["logits"], np.float32)
+        if status in (429, 503) and "retry_after_ms" in payload:
+            raise ShedError(payload["retry_after_ms"], 0)
+        raise RuntimeError(
+            f"/infer returned {status}: {payload.get('error', payload)}"
+        )
+
+    def healthz(self) -> Tuple[int, dict]:
+        return self.request_json("GET", "/healthz")
+
+    def stats(self) -> dict:
+        status, payload = self.request_json("GET", "/stats")
+        if status != 200:
+            raise RuntimeError(f"/stats returned {status}")
+        return payload
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+
 # ------------------------------------------------------------- HTTP front
 
-class _Handler(BaseHTTPRequestHandler):
-    # Set by _make_handler:
-    client: ServeClient = None  # type: ignore[assignment]
-    draining = None             # threading.Event
+class DrainAwareHandler(BaseHTTPRequestHandler):
+    """Keep-alive JSON-line handler base shared by ``dwt-serve`` and the
+    fleet balancer: HTTP/1.1 persistent connections, a drain-aware idle
+    wait, and body-draining replies (a keep-alive error response that
+    leaves the request body unread would desynchronize the connection —
+    the leftover bytes would parse as the NEXT request line)."""
+
+    draining = None             # threading.Event, set by the maker
     # Socket read timeout: handler threads are non-daemon and joined at
     # drain (no torn responses), so a client stalled mid-upload must not
     # be able to hold exit hostage.  Above the 60 s future timeout.
     timeout = 70.0
+    # Persistent connections: with HTTP/1.0 every request paid a fresh
+    # TCP connect — exactly the cost the bench measures at every offered
+    # load, and the load balancer would pay it per PROXIED request.
+    # Every response already carries Content-Length, so keep-alive is
+    # free; the drain-aware idle wait below keeps it compatible with the
+    # non-daemon-handler drain join.
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # route through logging, not stderr
         log.debug("http: " + fmt, *args)
+
+    def handle_one_request(self):
+        # Idle keep-alive wait in short select slices: a parked
+        # connection must neither hold the drain join hostage (handler
+        # threads are non-daemon and joined at server_close) nor pin the
+        # thread past the idle timeout.  Once bytes arrive, the normal
+        # request read (full ``timeout``) takes over.  (A pipelined
+        # second request sitting in the rfile buffer would wait for new
+        # socket bytes here — our clients are strictly request/response.)
+        idle_deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                ready, _, _ = select.select([self.connection], [], [], 0.5)
+            except (OSError, ValueError):  # connection torn down
+                self.close_connection = True
+                return
+            if ready:
+                break
+            if self.draining.is_set() or time.monotonic() > idle_deadline:
+                self.close_connection = True
+                return
+        super().handle_one_request()
+
+    def read_body(self) -> bytes:
+        """Read the request body.  EVERY POST branch must call this
+        before replying — including error replies — or the unread bytes
+        corrupt the next request on this keep-alive connection."""
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length > 0 else b""
 
     def _reply(self, code: int, payload: dict, headers=()) -> None:
         body = (json.dumps(payload) + "\n").encode()  # one JSON line
@@ -365,6 +527,11 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+
+class _Handler(DrainAwareHandler):
+    # Set by _make_handler:
+    client: ServeClient = None  # type: ignore[assignment]
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -385,6 +552,14 @@ class _Handler(BaseHTTPRequestHandler):
                     self.client.dispatcher_heartbeat_age_s, 3
                 ),
                 "step": self.client.engine.step,
+                # The served-version identity (step + short digest): the
+                # fleet's balancer and tests read which generation this
+                # replica is on without a /stats round trip.
+                "version": (
+                    self.client.engine.version.label
+                    if getattr(self.client.engine, "version", None)
+                    is not None else None
+                ),
                 **({"dispatcher_error": f"{type(err).__name__}: {err}"}
                    if err is not None else {}),
             })
@@ -394,12 +569,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
+        body = self.read_body()  # ALWAYS, even on error paths (keep-alive)
         if self.path not in ("/infer", "/v1/infer"):
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.loads(body or b"{}")
             x = np.asarray(payload["inputs"], np.float32)
             if x.ndim == len(self.client.engine.input_shape):
                 x = x[None]  # single sample -> batch of one
@@ -546,6 +721,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_queue", type=int, default=1024,
                    help="admission high-water mark in SAMPLES; beyond it "
                         "requests shed with 429 + Retry-After")
+    p.add_argument("--max_request_share", type=float, default=1.0,
+                   help="batching fairness: a single request may occupy "
+                        "at most this share of the largest bucket when "
+                        "sharing a batch; larger requests dispatch alone "
+                        "so they cannot drag small requests into a "
+                        "largest-bucket dispatch (1.0 = off)")
+    # ---- continuous deployment (dwt_tpu.fleet) ----
+    p.add_argument("--watch", action="store_true",
+                   help="hot reload: watch --ckpt_dir for new valid "
+                        "checkpoints, canary-gate each candidate, and "
+                        "swap it in atomically between dispatches "
+                        "(zero-downtime; auto-rollback on post-swap "
+                        "regression)")
+    p.add_argument("--reload_poll_s", type=float, default=2.0,
+                   help="checkpoint watch poll period (seconds)")
+    p.add_argument("--canary_fixture", default=None,
+                   help=".npz with arrays x [n,...sample] and optional y "
+                        "[n]: the held-out batch every candidate must "
+                        "pass (finite logits; with y, accuracy within "
+                        "--canary_max_regress of the live version) "
+                        "before going live.  Default: a fixed noise "
+                        "batch (finiteness gate only)")
+    p.add_argument("--canary_batch", type=int, default=8,
+                   help="noise-fixture batch size when no "
+                        "--canary_fixture is given")
+    p.add_argument("--canary_max_regress", type=float, default=5.0,
+                   help="max fixture-accuracy regression (percentage "
+                        "points) vs the live version before a candidate "
+                        "is refused (labelled fixtures only)")
+    p.add_argument("--rollback_error_rate", type=float, default=0.1,
+                   help="post-swap: error rate above this over the new "
+                        "version's access window triggers auto-rollback")
+    p.add_argument("--rollback_p99_factor", type=float, default=3.0,
+                   help="post-swap: e2e p99 above this factor of the "
+                        "pre-swap baseline triggers auto-rollback")
+    p.add_argument("--rollback_min_requests", type=int, default=50,
+                   help="post-swap verdict window: requests the new "
+                        "version must serve before a latency verdict")
+    p.add_argument("--rollback_decide_s", type=float, default=30.0,
+                   help="post-swap grace period: with a thin window and "
+                        "no error trip, hold the version after this long")
     p.add_argument("--data_parallel", action="store_true",
                    help="shard every bucket over all local devices (data "
                         "mesh replica fan-out)")
@@ -569,10 +785,52 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def load_canary_fixture(args, input_shape):
+    """The held-out batch every candidate must pass: ``--canary_fixture``
+    .npz (x + optional y) or a FIXED seeded-noise batch (finiteness gate
+    only — noise labels would make the accuracy bar meaningless)."""
+    if args.canary_fixture:
+        data = np.load(args.canary_fixture)
+        x = np.asarray(data["x"], np.float32)
+        y = np.asarray(data["y"]) if "y" in data else None
+        return x, y
+    rng = np.random.default_rng(args.seed)
+    x = rng.normal(
+        size=(max(1, args.canary_batch),) + tuple(input_shape)
+    ).astype(np.float32)
+    return x, None
+
+
+def build_reloader(args, engine, access_log):
+    """--watch wiring: watcher + canary gate + post-swap monitor around
+    the live engine.  Imported lazily — ``dwt_tpu.fleet`` pulls in the
+    serve package and a module-level import would cycle."""
+    from dwt_tpu.fleet import CanaryGate, HotReloader, PostSwapMonitor
+
+    x, y = load_canary_fixture(args, engine.input_shape)
+    return HotReloader(
+        engine, args.ckpt_dir,
+        access_log=access_log,
+        poll_s=args.reload_poll_s,
+        canary=CanaryGate(
+            engine, x, y, max_regress_pp=args.canary_max_regress
+        ),
+        monitor=PostSwapMonitor(
+            access_log,
+            error_rate_threshold=args.rollback_error_rate,
+            p99_factor=args.rollback_p99_factor,
+            min_requests=args.rollback_min_requests,
+            decide_after_s=args.rollback_decide_s,
+        ),
+    )
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
     obs.maybe_enable(args.obs_trace)
+    if args.watch and not args.ckpt_dir:
+        raise SystemExit("dwt-serve: --watch requires --ckpt_dir")
     engine = build_engine(args)
     access_log = AccessLog(args.access_log)
     client = ServeClient(
@@ -580,7 +838,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_batch_delay_ms=args.max_batch_delay_ms,
         max_queue_items=args.max_queue,
         access_log=access_log,
+        max_request_share=args.max_request_share,
     )
+    reloader = None
+    if args.watch:
+        reloader = build_reloader(args, engine, access_log)
+        reloader.start()
 
     # Flag-only signal handling (the resilience PreemptionHandler
     # pattern): the handler must not touch locks/buffered I/O; the main
@@ -616,11 +879,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "host": args.host, "port": httpd.server_address[1],
         "buckets": list(engine.buckets),
         "step": engine.step, "source": engine.source,
+        "version": engine.version.label,
+        "watch": bool(args.watch),
         "compile_s": engine.compile_s,
     }), flush=True)
 
     draining.wait()  # the serving steady state lives on other threads
     log.info("drain: SIGTERM/SIGINT received; completing in-flight work")
+    if reloader is not None:
+        # Stop deploying before draining: a swap landing mid-drain would
+        # be harmless (in-flight batches pin their snapshot) but would
+        # muddy the final summary's version attribution.
+        reloader.stop()
     # Half-close order: (1) stop admitting (new requests shed with
     # retry-after — the handler's `draining` check plus the batcher's
     # drain mode), (2) flush the queue through the engine, (3) stop the

@@ -1,0 +1,852 @@
+"""Continuous-deployment fleet tests (ISSUE-11).
+
+Tier-1 (fast): watcher validity/dedup semantics, bitwise served-logits
+parity across a same-checkpoint hot swap, a mid-load swap shedding zero
+requests with every access record single-version per batch, the canary
+refusing NaN / digest-corrupt / accuracy-regressed candidates end to end
+(on-disk artifacts), fake-clock post-swap rollback verdicts + the
+reloader's rollback-and-blacklist path, in-process balancer routing /
+ejection / re-admission, per-version access windows, and keep-alive
+connection reuse against a live server.
+
+Slow-marked (tools/t1_budget.py discipline): the dwt-fleet CLI
+subprocess matrix (SIGKILLed replica ejection + fleet drain) and the
+sustained-open-loop swap-latency acceptance run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ shared state
+
+@pytest.fixture(scope="module")
+def fleet_setup(tmp_path_factory):
+    """One LeNet train state + checkpoint dir + engine for the fleet
+    tests (compiles and checkpoint writes are the cost; sharing keeps
+    this file inside the tier-1 budget)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.train import create_train_state
+    from dwt_tpu.utils import save_state
+
+    model = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    ckpt_dir = str(tmp_path_factory.mktemp("fleet_ckpts"))
+    save_state(ckpt_dir, 1, state.replace(step=1))
+    engine = ServeEngine.from_checkpoint(
+        ckpt_dir, model, (28, 28, 1), buckets=(1, 4, 8)
+    )
+    return model, state, ckpt_dir, engine
+
+
+def _save_step(ckpt_dir, state, step, perturb=0.0):
+    import jax
+
+    from dwt_tpu.utils import save_state
+
+    s = state
+    if perturb:
+        s = s.replace(
+            params=jax.tree.map(lambda a: a + perturb, state.params)
+        )
+    save_state(ckpt_dir, step, s.replace(step=step))
+
+
+# ----------------------------------------------------------------- watcher
+
+def test_watcher_sees_only_valid_finalized_steps(tmp_path, fleet_setup):
+    from dwt_tpu.fleet.watcher import CheckpointWatcher, newest_candidate
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    assert newest_candidate(d) is None  # nothing yet
+
+    _save_step(d, state, 3)
+    cand = newest_candidate(d)
+    assert cand is not None and cand.step == 3
+    assert cand.digest is not None and len(cand.digest) == 64
+    assert cand.source == "checkpoint"
+
+    # An unpromoted tmp dir is invisible by construction.
+    os.makedirs(os.path.join(d, ".tmp-mh-9", "shard_0"))
+    assert newest_candidate(d).step == 3
+
+    # A torn checkpoint (manifest lists a missing file) is skipped.
+    os.makedirs(os.path.join(d, "7"))
+    with open(os.path.join(d, "7", "manifest.json"), "w") as f:
+        json.dump({"step": 7, "params_digest": "x",
+                   "files": {"gone.bin": 123}}, f)
+    assert newest_candidate(d).step == 3
+
+    w = CheckpointWatcher(d, poll_s=0.01)
+    first = w.poll_once()
+    assert first is not None and first.step == 3
+    assert w.poll_once() is None  # dedup: same (step, digest)
+    _save_step(d, state, 5, perturb=0.01)
+    nxt = w.poll_once()
+    assert nxt is not None and nxt.step == 5
+    assert nxt.digest != first.digest  # content identity moved
+
+
+# --------------------------------------------------- hot swap: bitwise no-op
+
+def test_hot_swap_same_checkpoint_bitwise_noop(fleet_setup):
+    """Acceptance: a hot swap of the SAME checkpoint is numerically a
+    no-op — served logits are bitwise identical before, across, and
+    after the swap (same compiled executables, same weights, new device
+    placement)."""
+    from dwt_tpu.fleet.watcher import newest_candidate
+    from dwt_tpu.serve.engine import Version
+    from dwt_tpu.utils.checkpoint import restore_tree
+
+    model, state, ckpt_dir, engine = fleet_setup
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(5, 28, 28, 1)).astype(np.float32)
+    before = engine.infer(x)
+
+    cand = newest_candidate(ckpt_dir)
+    tree = restore_tree(cand.path)
+    new_state = engine.build_state_from_tree(
+        tree, version=Version(cand.step, cand.digest)
+    )
+    prev = engine.swap(new_state)
+    try:
+        after = engine.infer(x)
+        np.testing.assert_array_equal(before, after)
+        assert engine.version.label == new_state.version.label
+    finally:
+        engine.swap(prev)  # leave the shared fixture untouched
+
+
+# ------------------------------------------- mid-load swap: zero shed, 1 ver
+
+def test_mid_load_swap_zero_shed_no_mixed_version_batch(fleet_setup):
+    """Acceptance: a swap under load sheds ZERO requests, fails none,
+    and never emits a mixed-version batch — proven from the
+    version-stamped access records (every batch_seq maps to exactly one
+    version; both versions appear)."""
+    from dwt_tpu.fleet.watcher import newest_candidate
+    from dwt_tpu.serve import ServeClient
+    from dwt_tpu.serve.engine import Version
+    from dwt_tpu.serve.metrics import AccessLog
+    from dwt_tpu.utils.checkpoint import restore_tree
+
+    model, state, ckpt_dir, engine = fleet_setup
+    access = AccessLog()
+    client = ServeClient(engine, max_batch_delay_ms=1.0, access_log=access)
+    records = []
+    orig_record = access.record
+
+    def tee_record(status, n, **fields):
+        records.append({"status": status, "n": n, **fields})
+        orig_record(status, n, **fields)
+
+    access.record = tee_record
+    cand = newest_candidate(ckpt_dir)
+    tree = restore_tree(cand.path)
+    old_version = engine.version
+    rng = np.random.default_rng(9)
+    xs = [rng.normal(size=(k, 28, 28, 1)).astype(np.float32)
+          for k in (1, 2, 3, 1, 2, 1, 4, 2)]
+    futures = []
+    swapped = threading.Event()
+    prev_holder = {}
+
+    def _load():
+        for i in range(120):
+            futures.append(client.submit(xs[i % len(xs)]))
+            if i == 40 and not swapped.is_set():
+                # Swap mid-load, on another thread like the reloader.
+                new_state = engine.build_state_from_tree(
+                    tree, version=Version(999, cand.digest)
+                )
+                prev_holder["prev"] = engine.swap(new_state)
+                swapped.set()
+            time.sleep(0.001)
+
+    try:
+        loader = threading.Thread(target=_load)
+        loader.start()
+        loader.join(timeout=120)
+        assert not loader.is_alive()
+        for f in futures:
+            assert f.result(timeout=60.0) is not None  # zero failed
+        assert swapped.is_set()
+    finally:
+        client.close()
+        if "prev" in prev_holder:
+            engine.swap(prev_holder["prev"])
+
+    oks = [r for r in records if r["status"] == "ok"]
+    assert len(oks) == 120           # every submitted request served
+    assert access.shed_requests == 0  # zero shed through the swap
+    assert access.error_requests == 0
+    by_batch = {}
+    for r in oks:
+        assert "version" in r and "batch_seq" in r  # stamped on every record
+        by_batch.setdefault(r["batch_seq"], set()).add(r["version"])
+    for seq, versions in by_batch.items():
+        assert len(versions) == 1, (
+            f"batch {seq} mixed versions: {versions}"
+        )
+    seen = set().union(*by_batch.values())
+    assert old_version.label in seen and f"999-{cand.digest[:8]}" in seen
+
+
+# ------------------------------------------------------------- canary gate
+
+def test_canary_refuses_nan_param_candidate(tmp_path, fleet_setup):
+    """A NaN-param checkpoint (digest-VALID: the digest proves integrity,
+    not health) must be refused by the canary's fixture eval and never
+    go live."""
+    import orbax.checkpoint as ocp
+
+    import jax
+    from dwt_tpu.fleet import CanaryGate, HotReloader
+    from dwt_tpu.serve import AccessLog, ServeEngine
+    from dwt_tpu.utils.checkpoint import _write_manifest, params_digest
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    _save_step(d, state, 1)
+    engine = ServeEngine.from_checkpoint(d, model, (28, 28, 1),
+                                         buckets=(4,))
+    nan_params = jax.tree.map(
+        lambda a: np.full_like(np.asarray(a), np.nan), state.params
+    )
+    tree = {"step": np.int64(2), "params": nan_params,
+            "batch_stats": jax.device_get(state.batch_stats)}
+    root = os.path.abspath(d)
+    tmp = os.path.join(root, ".tmp-nan")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(tmp, jax.device_get(tree))
+    _write_manifest(tmp, 2, params_digest(nan_params))
+    os.replace(tmp, os.path.join(root, "2"))
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    alog = AccessLog()
+    reloader = HotReloader(
+        engine, d, access_log=alog, canary=CanaryGate(engine, x)
+    )
+    live_before = engine.version.label
+    reloader.step()
+    assert engine.version.label == live_before  # candidate never went live
+    assert reloader.swap_count == 0
+    assert len(reloader.rejected) == 1
+    reason = next(iter(reloader.rejected.values()))
+    assert "non-finite" in reason
+    reloader.step()  # blacklisted: not retried
+    assert reloader.swap_count == 0
+
+
+def test_canary_refuses_digest_corrupt_candidate(tmp_path, fleet_setup):
+    """A candidate whose bytes do not match its manifest digest must be
+    refused at restore (the digest re-verification) — the live version
+    keeps serving."""
+    from dwt_tpu.fleet import CanaryGate, HotReloader
+    from dwt_tpu.serve import AccessLog, ServeEngine
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    _save_step(d, state, 1)
+    engine = ServeEngine.from_checkpoint(d, model, (28, 28, 1),
+                                         buckets=(4,))
+    # Step 2: valid save, then flip its manifest digest (equivalently:
+    # bit corruption in the array bytes; either way restore_tree's
+    # re-verification must refuse it).
+    _save_step(d, state, 2, perturb=0.01)
+    mpath = os.path.join(d, "2", "manifest.json")
+    manifest = json.load(open(mpath))
+    manifest["params_digest"] = "0" * 64
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    reloader = HotReloader(
+        engine, d, access_log=AccessLog(),
+        canary=CanaryGate(engine, x),
+    )
+    live_before = engine.version.label
+    reloader.step()
+    assert engine.version.label == live_before
+    assert reloader.swap_count == 0
+    reason = next(iter(reloader.rejected.values()))
+    assert "digest" in reason or "restore/build" in reason
+
+
+def test_canary_refuses_accuracy_regressed_candidate(tmp_path, fleet_setup):
+    """With a labelled fixture, a candidate whose fixture accuracy falls
+    more than max_regress_pp below the live version's is refused even
+    though its logits are perfectly finite."""
+    import jax
+
+    from dwt_tpu.fleet import CanaryGate
+    from dwt_tpu.serve import ServeEngine
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    _save_step(d, state, 1)
+    engine = ServeEngine.from_checkpoint(d, model, (28, 28, 1),
+                                         buckets=(8,))
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    # Labels = the live model's own predictions: live accuracy 100%.
+    y = np.argmax(engine.infer(x), axis=-1)
+    gate = CanaryGate(engine, x, y, max_regress_pp=5.0)
+    assert gate.baseline() == 100.0
+
+    good = engine.build_state(state.params, state.batch_stats)
+    assert gate.check(good).ok  # the live weights pass their own bar
+
+    scrambled = jax.tree.map(
+        lambda a: np.asarray(
+            rng.permutation(np.asarray(a).ravel()).reshape(a.shape),
+            np.asarray(a).dtype,
+        ),
+        jax.device_get(state.params),
+    )
+    bad = engine.build_state(scrambled, state.batch_stats)
+    verdict = gate.check(bad)
+    if verdict.ok:  # permuted weights could fluke the tiny fixture
+        pytest.skip("scrambled candidate matched labels by chance")
+    assert "regressed" in verdict.reason or "non-finite" in verdict.reason
+
+
+# ---------------------------------------------------- post-swap rollback
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_post_swap_monitor_verdicts_fake_clock():
+    from dwt_tpu.fleet import PostSwapMonitor
+    from dwt_tpu.serve import AccessLog
+
+    alog = AccessLog()
+    clock = _FakeClock()
+    mon = PostSwapMonitor(
+        alog, error_rate_threshold=0.2, p99_factor=2.0,
+        min_requests=10, decide_after_s=30.0, clock=clock,
+    )
+    assert mon.verdict() is None  # not armed
+    mon.arm("v2", baseline_p99=10.0)
+    assert mon.verdict() is None  # window empty, inside grace
+
+    # Healthy traffic: verdict "ok" once the window fills.
+    for _ in range(10):
+        alog.record("ok", 1, version="v2", e2e_ms=12.0)
+    assert mon.verdict() == "ok"
+
+    # p99 blown past factor x baseline: rollback.
+    mon.arm("v3", baseline_p99=10.0)
+    for _ in range(10):
+        alog.record("ok", 1, version="v3", e2e_ms=25.0)
+    v = mon.verdict()
+    assert v is not None and v.startswith("rollback") and "p99" in v
+
+    # Error-rate trip fires FAST (before min_requests).
+    mon.arm("v4", baseline_p99=10.0)
+    for _ in range(8):
+        alog.record("error", 1, version="v4", error="boom")
+    v = mon.verdict()
+    assert v is not None and v.startswith("rollback") and "error_rate" in v
+
+    # Thin window, grace expired, no errors: hold the version.
+    mon.arm("v5", baseline_p99=10.0)
+    clock.t += 31.0
+    assert mon.verdict() == "ok"
+
+
+def test_reloader_auto_rollback_to_last_good(tmp_path, fleet_setup):
+    """Acceptance: a post-swap regression rolls back to the last-good
+    version automatically, and the regressed version is blacklisted so
+    the watcher re-seeing it does not redeploy it."""
+    from dwt_tpu.fleet import HotReloader, PostSwapMonitor
+    from dwt_tpu.serve import AccessLog, ServeEngine
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    _save_step(d, state, 1)
+    engine = ServeEngine.from_checkpoint(d, model, (28, 28, 1),
+                                         buckets=(4,))
+    v1 = engine.version.label
+    alog = AccessLog()
+    clock = _FakeClock()
+    mon = PostSwapMonitor(
+        alog, error_rate_threshold=0.2, min_requests=8,
+        decide_after_s=1000.0, clock=clock,
+    )
+    reloader = HotReloader(engine, d, access_log=alog, monitor=mon)
+
+    _save_step(d, state, 2, perturb=0.01)
+    reloader.step()
+    assert reloader.swap_count == 1
+    v2 = engine.version.label
+    assert v2 != v1 and mon.armed
+
+    # The new version serves nothing but errors.
+    for _ in range(8):
+        alog.record("error", 1, version=v2, error="boom")
+    reloader.step()
+    assert reloader.rollback_count == 1
+    assert engine.version.label == v1       # rolled back to last-good
+    assert not mon.armed
+    reloader.step()                         # v2 blacklisted: no redeploy
+    assert engine.version.label == v1 and reloader.swap_count == 1
+
+    # A NEWER (good) candidate still deploys after the rollback.
+    _save_step(d, state, 3, perturb=0.02)
+    reloader.step()
+    assert reloader.swap_count == 2
+    assert engine.version.label not in (v1, v2)
+
+
+# ------------------------------------------------- access-log version view
+
+def test_access_log_version_windows_and_events():
+    from dwt_tpu.serve import AccessLog
+
+    alog = AccessLog()
+    for _ in range(4):
+        alog.record("ok", 1, version="v1", e2e_ms=10.0)
+    alog.record("error", 1, version="v1", error="x")
+    alog.record("ok", 2, version="v2", e2e_ms=20.0)
+    s1 = alog.version_stats("v1")
+    assert s1["served"] == 4 and s1["errors"] == 1
+    assert s1["error_rate"] == pytest.approx(0.2)
+    assert s1["e2e_ms_p99"] == 10.0
+    assert alog.version_stats("nope") == {}
+    summary = alog.summary()
+    assert set(summary["versions"]) == {"v1", "v2"}
+    assert summary["versions"]["v2"]["served"] == 1
+
+    # Fleet lifecycle events ride the same stream.
+    import io
+
+    buf = io.StringIO()
+    alog2 = AccessLog(stream=buf)
+    alog2.event("swap", version="v2", from_version="v1")
+    alog2.record("ok", 1, version="v2", e2e_ms=1.0)
+    kinds = [json.loads(line)["kind"]
+             for line in buf.getvalue().splitlines()]
+    assert kinds == ["swap", "access"]
+
+    # The version map is bounded: old versions fall off, no leak.
+    for i in range(50):
+        alog.record("ok", 1, version=f"v{i}", e2e_ms=1.0)
+    assert len(alog.summary().get("versions", {})) <= 8
+
+
+# ------------------------------------------------- balancer (in-process)
+
+class _StubReplicaServer:
+    """Tiny in-process HTTP backend standing in for a dwt-serve replica."""
+
+    def __init__(self, healthy=True):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                code = 200 if stub.healthy else 503
+                self._reply(code, {
+                    "ok": stub.healthy,
+                    "queued_items": 0,
+                    "dispatcher_heartbeat_age_s": 0.1,
+                    "version": "stub-1",
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                stub.served += 1
+                self._reply(200, {"logits": [[0.0]], "replica": stub.port})
+
+        self.healthy = True if healthy else False
+        self.served = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_balancer_routing_ejection_readmission():
+    """In-process: least-outstanding routing over healthy replicas; a
+    503 replica is ejected and RE-ADMITTED once healthy again; a dead
+    backend is ejected on probe failure and traffic keeps flowing."""
+    from dwt_tpu.fleet.balancer import HealthProber, Replica, ReplicaSet
+
+    a, b = _StubReplicaServer(), _StubReplicaServer()
+    try:
+        ra = Replica(0, "127.0.0.1", a.port)
+        rb = Replica(1, "127.0.0.1", b.port)
+        rset = ReplicaSet([ra, rb])
+        prober = HealthProber(rset, interval_s=1000.0)  # manual probes
+
+        prober.probe_once()
+        assert rset.healthy_count() == 2
+        # Least-outstanding with round-robin ties: alternates.
+        p1 = rset.pick()
+        p2 = rset.pick()
+        assert {p1.rid, p2.rid} == {0, 1}
+        assert p1.outstanding == 1 and p2.outstanding == 1
+        rset.release(p1, ok=True)
+        rset.release(p2, ok=True)
+        # A loaded replica is skipped until it drains.
+        busy = rset.pick()
+        idle = rset.pick()
+        rset.release(idle, ok=True)
+        assert rset.pick().rid == idle.rid  # busy one still outstanding
+        rset.release(busy, ok=True)
+        rset.release(idle, ok=True)
+
+        # 503 -> ejected; healthy again -> re-admitted.
+        a.healthy = False
+        prober.probe_once()
+        assert not ra.healthy and rset.healthy_count() == 1
+        assert rset.pick().rid == 1  # only the healthy one routes
+        rset.release(rb, ok=True)
+        a.healthy = True
+        prober.probe_once()
+        assert ra.healthy and rset.healthy_count() == 2
+
+        # Dead backend (connection refused) -> ejected.
+        b.stop()
+        prober.probe_once()
+        assert not rb.healthy and rset.healthy_count() == 1
+    finally:
+        a.stop()
+        try:
+            b.stop()
+        except Exception:
+            pass
+
+
+def test_balancer_front_proxies_and_503s_when_empty():
+    """The balancer's own HTTP front: proxies /infer to a healthy stub
+    replica (keep-alive upstream pool) and answers 503 + Retry-After
+    once every replica is ejected."""
+    from http.server import ThreadingHTTPServer
+
+    from dwt_tpu.fleet.balancer import (
+        HealthProber,
+        Replica,
+        ReplicaSet,
+        make_handler,
+    )
+    from dwt_tpu.serve.server import HttpServeClient
+
+    stub = _StubReplicaServer()
+    rset = ReplicaSet([Replica(0, "127.0.0.1", stub.port)])
+    draining = threading.Event()
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), make_handler(rset, draining)
+    )
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    client = HttpServeClient("127.0.0.1", port)
+    try:
+        status, payload = client.request_json(
+            "POST", "/infer", {"inputs": [[0.0]]}
+        )
+        assert status == 200 and "logits" in payload
+        status, health = client.healthz()
+        assert status == 200 and health["healthy_replicas"] == 1
+        # Eject the only replica: the front answers 503 with retry-after.
+        prober = HealthProber(rset, interval_s=1000.0)
+        stub.healthy = False
+        prober.probe_once()
+        status, payload = client.request_json(
+            "POST", "/infer", {"inputs": [[0.0]]}
+        )
+        assert status == 503 and "retry_after_ms" in payload
+        status, health = client.healthz()
+        assert status == 503 and not health["ok"]
+    finally:
+        client.close()
+        draining.set()
+        httpd.shutdown()
+        httpd.server_close()
+        stub.stop()
+
+
+def test_http_keepalive_connection_reused():
+    """Satellite: the HTTP path reuses ONE TCP connection across
+    requests (HTTP/1.1 keep-alive) — under HTTP/1.0 the second request
+    on the same connection would fail with a closed socket."""
+    import http.client
+
+    stub = _StubReplicaServer()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", stub.port,
+                                          timeout=10.0)
+        for _ in range(3):
+            conn.request("POST", "/infer", body=b"{}")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+        # One connection, three requests: the stub's counter agrees and
+        # the socket object never changed.
+        assert stub.served == 3
+        conn.close()
+    finally:
+        stub.stop()
+
+
+# ---------------------------------------------------------- fairness plan
+
+def test_plan_dispatch_fairness_cap_fake_clock():
+    """Satellite: a giant request past max_share of the largest bucket
+    dispatches ALONE — small requests no longer coalesce behind it into
+    a largest-bucket dispatch whose device time blows their deadline."""
+    from dwt_tpu.serve.batcher import MicroBatcher, plan_dispatch
+
+    buckets = (1, 8, 32)
+    # Legacy (max_share=1): giant+smalls coalesce into the big bucket.
+    assert plan_dispatch([16, 1, 1], buckets, now=1.0, oldest_t=0.0,
+                         max_delay_s=0.005) == 3
+    # Capped: the giant (16 > 0.25*32=8) is solo; followers waiting
+    # means it dispatches NOW, smalls ride the next (small) plan.
+    assert plan_dispatch([16, 1, 1], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 1
+    assert plan_dispatch([1, 1], buckets, now=10.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 2
+    # A giant mid-queue ends the prefix before it: smalls go now.
+    assert plan_dispatch([1, 1, 16, 1], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 2
+    # A lone capped giant still honors its own deadline.
+    assert plan_dispatch([16], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 0
+    assert plan_dispatch([16], buckets, now=10.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 1
+    # A largest-bucket-filling request dispatches immediately either way.
+    assert plan_dispatch([32], buckets, now=0.0, oldest_t=0.0,
+                         max_delay_s=10.0, max_share=0.25) == 1
+    # max_share=1 is bitwise the legacy rule.
+    for q in ([3], [8, 8, 16], [8, 8, 20], [1, 31]):
+        assert plan_dispatch(q, buckets, now=0.004, oldest_t=0.0,
+                             max_delay_s=0.005, max_share=1.0) \
+            == plan_dispatch(q, buckets, now=0.004, oldest_t=0.0,
+                             max_delay_s=0.005)
+    with pytest.raises(ValueError):
+        MicroBatcher(buckets=buckets, max_request_share=0.0)
+    with pytest.raises(ValueError):
+        MicroBatcher(buckets=buckets, max_request_share=1.5)
+
+    clock = _FakeClock()
+    b = MicroBatcher(buckets=(1, 8, 32), max_batch_delay_ms=5.0,
+                     clock=clock, max_request_share=0.25)
+    b.submit(np.ones((16, 2, 2, 1), np.float32))
+    b.submit(np.ones((1, 2, 2, 1), np.float32))
+    b.submit(np.ones((1, 2, 2, 1), np.float32))
+    pb1 = b.next_batch(timeout=0)   # the giant, alone, immediately
+    assert pb1 is not None and pb1.real_n == 16 and len(pb1.requests) == 1
+    pb2 = b.next_batch(timeout=0)   # wait: smalls under their own deadline
+    assert pb2 is None
+    clock.t = 0.006
+    pb3 = b.next_batch(timeout=0)
+    assert pb3 is not None and pb3.real_n == 2 and pb3.bucket == 8
+
+
+# ------------------------------------------------- watch over HTTP (E2E)
+
+def test_serve_watch_hot_reload_over_http(tmp_path, fleet_setup):
+    """End to end through the real server process: --watch picks up a
+    new checkpoint written while serving, the canary passes it, /healthz
+    reports the new version, requests keep succeeding throughout, and
+    the drain is clean.  Also exercises keep-alive against dwt-serve
+    itself (one HttpServeClient connection across every request)."""
+    from dwt_tpu.serve.server import HttpServeClient
+
+    model, state, _, _ = fleet_setup
+    d = str(tmp_path / "ck")
+    _save_step(d, state, 1)
+    access = str(tmp_path / "access.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.serve.server",
+         "--ckpt_dir", d, "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2", "--port", "0",
+         "--watch", "--reload_poll_s", "0.2",
+         "--access_log", access],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = None
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "serve_ready" and ready["watch"]
+        v1 = ready["version"]
+        client = HttpServeClient("127.0.0.1", ready["port"], timeout=30.0)
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        assert client.infer(x).shape == (1, 10)
+
+        _save_step(d, state, 2, perturb=0.01)
+        deadline = time.monotonic() + 60
+        v2 = v1
+        while time.monotonic() < deadline:
+            assert client.infer(x).shape == (1, 10)  # serving throughout
+            status, health = client.healthz()
+            assert status == 200
+            v2 = health["version"]
+            if v2 != v1:
+                break
+            time.sleep(0.2)
+        assert v2 != v1, "hot reload never landed"
+        assert v2.startswith("2-")
+        stats = client.stats()
+        assert stats["version"] == v2 and stats["swap_count"] >= 1
+    finally:
+        if client is not None:
+            client.close()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert rc == 0, proc.stderr.read()[-2000:]
+    # The JSONL stream carries the deployment audit trail.
+    kinds = [json.loads(line)["kind"]
+             for line in open(access).read().splitlines()]
+    assert "swap" in kinds and "access" in kinds
+
+
+# -------------------------------------------------------------- slow tier
+
+@pytest.mark.slow
+def test_fleet_cli_sigkill_ejection_keeps_serving(tmp_path):
+    """Acceptance: dwt-fleet spawns N replicas behind the balancer; a
+    SIGKILLed replica is ejected by the health probe and the fleet keeps
+    serving on the survivors; SIGTERM drains the whole fleet to exit 0."""
+    import urllib.request
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.fleet.balancer",
+         "--replicas", "2", "--port", "0",
+         "--health_interval_s", "0.3", "--",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "fleet_ready"
+        port = ready["port"]
+        body = json.dumps(
+            {"inputs": np.zeros((1, 28, 28, 1)).tolist()}
+        ).encode()
+
+        def infer():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/infer", data=body, method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+
+        for _ in range(6):
+            status, payload = infer()
+            assert status == 200 and "logits" in payload
+
+        os.kill(ready["replicas"][0]["pid"], signal.SIGKILL)
+        time.sleep(1.5)  # a few probe periods
+        for _ in range(6):
+            status, payload = infer()
+            assert status == 200 and "logits" in payload
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] and health["healthy_replicas"] == 1
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        summary = json.loads(
+            proc.stdout.read().strip().splitlines()[-1]
+        )
+        assert summary["kind"] == "fleet_summary"
+        assert summary["unclean_drains"] == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_sustained_load_swap_p99_within_2x_steady(tmp_path, fleet_setup):
+    """Acceptance: under sustained open-loop load, hot swaps complete
+    with zero shed/failed requests and the swap-window p99 stays within
+    2x the steady-state p99 (the pointer flip, not a pause)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from serve_bench import run_load
+
+    from dwt_tpu.fleet import HotReloader
+    from dwt_tpu.serve import ServeClient
+
+    model, state, ckpt_dir, engine = fleet_setup
+    client = ServeClient(engine, max_batch_delay_ms=2.0,
+                         max_queue_items=512)
+    reloader = HotReloader(
+        engine, ckpt_dir, access_log=client.access_log
+    )
+    try:
+        client.infer(np.zeros((1, 28, 28, 1), np.float32))  # warm
+        record = run_load(
+            client, (28, 28, 1), offered=200.0, seconds=8.0,
+            request_n=1, reloader=reloader, reload_every_s=1.5,
+        )
+    finally:
+        client.close()
+    assert record["shed"] == 0 and record["errors"] == 0
+    assert record["swaps"] >= 3
+    assert record["swap_requests"] > 0
+    # The atomic flip must not tear the tail: swap-window p99 within 2x
+    # steady-state (plus a floor absorbing CPU timer noise at small ms).
+    steady = record["steady_e2e_ms_p99"]
+    swap = record["swap_e2e_ms_p99"]
+    assert swap <= max(2.0 * steady, steady + 25.0), record

@@ -391,7 +391,7 @@ def build_report(trace_paths: List[str],
             proc["train"] = tb
         for cat, key in (("detail", "detail"), ("eval", "eval"),
                          ("ckpt", "ckpt"), ("data", "data"),
-                         ("shard", "shard")):
+                         ("shard", "shard"), ("fleet", "fleet")):
             s = category_summary(events, pid, cat)
             if s:
                 proc[key] = s
@@ -439,7 +439,8 @@ def main(argv=None) -> int:
                            ("ckpt", "checkpoint pipeline"),
                            ("data", "prefetch producer"),
                            ("shard", "sharding plan (place/gather/"
-                                     "restore)")):
+                                     "restore)"),
+                           ("fleet", "fleet (reload/canary/swap)")):
             if key in proc:
                 print_category(f"{title} (pid {pid})", proc[key])
         if "serve" in proc:

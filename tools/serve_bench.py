@@ -23,6 +23,12 @@ In-process by default (``ServeClient`` — no HTTP overhead, measures the
 batcher+engine path the server wraps).  CPU numbers are a functional
 floor; the chip round re-runs this against the TPU roofline (PERF.md
 "Serving path").
+
+``--reload_every N`` (with ``--ckpt_dir``) hot-swaps the newest
+checkpoint every N seconds DURING each load — the continuous-deployment
+fleet's restore → build → canary → atomic-swap path under traffic —
+and splits the served tail into swap-window vs steady-state percentiles
+(PERF.md "Fleet").
 """
 
 from __future__ import annotations
@@ -57,13 +63,23 @@ def _build_client(args):
 
 
 def run_load(client, input_shape, offered: float, seconds: float,
-             request_n: int, seed: int = 0) -> dict:
+             request_n: int, seed: int = 0,
+             reloader=None, reload_every_s: float = 0.0,
+             swap_window_s: float = 0.5) -> dict:
     """One open-loop measurement at ``offered`` imgs/s for ``seconds``.
 
     Arrivals are Poisson (exponential gaps) in REQUEST units
     (``offered / request_n`` requests/s); each request is ``request_n``
     images of noise (serving cost is shape-, not content-, dependent).
     Shed requests are counted, not retried — the open-loop contract.
+
+    ``reloader`` + ``reload_every_s``: a hot-swap thread force-redeploys
+    the newest checkpoint every ``reload_every_s`` seconds DURING the
+    load (a same-checkpoint swap — numerically a no-op, operationally
+    the full restore → build → swap path).  The record then splits the
+    latency tail into ``swap_*`` (requests resolved within
+    ``swap_window_s`` after a swap, sliced on the access log's
+    resolution stamps) vs ``steady_*`` — the swap-cost-under-load probe.
     """
     from dwt_tpu.serve.batcher import ShedError
 
@@ -83,6 +99,22 @@ def run_load(client, input_shape, offered: float, seconds: float,
     # latency.  Count-diffed windows isolate THIS load point's samples
     # from earlier sweep points and the warmup.
     before = client.access_log.windows()
+    done = threading.Event()
+    swap_ts = []  # resolution-stamp timebase (seconds since log t0)
+
+    def _swap_loop():
+        while not done.wait(reload_every_s):
+            try:
+                # Stamp AFTER the deploy returns: the restore/build runs
+                # concurrently with serving (its contention shows in the
+                # overall tail); the swap window measures the pointer
+                # flip's own impact on in-flight traffic.
+                if reloader.reload_newest(force=True, skip_canary=False):
+                    swap_ts.append(
+                        time.perf_counter() - client.access_log.t0
+                    )
+            except Exception as e:  # keep the bench honest, not dead
+                print(f"serve_bench: swap failed: {e}", file=sys.stderr)
 
     def _submit_all():
         nonlocal shed
@@ -97,8 +129,13 @@ def run_load(client, input_shape, offered: float, seconds: float,
                 shed += 1
 
     submitter = threading.Thread(target=_submit_all, daemon=True)
+    swapper = None
+    if reloader is not None and reload_every_s > 0:
+        swapper = threading.Thread(target=_swap_loop, daemon=True)
     t_start = time.perf_counter()
     submitter.start()
+    if swapper is not None:
+        swapper.start()
     submitter.join()
     # Harvest: every accepted request must resolve (bounded queue + the
     # dispatcher draining it guarantee this terminates promptly).
@@ -107,7 +144,13 @@ def run_load(client, input_shape, offered: float, seconds: float,
             fut.result(timeout=60.0)
         except Exception:
             errors += 1
+    # Clock stops when the last request resolves — BEFORE joining the
+    # swapper, whose tail reload would otherwise inflate duration_s (and
+    # deflate achieved rate) in exactly the reloading arm of the A/B.
     elapsed = time.perf_counter() - t_start
+    done.set()
+    if swapper is not None:
+        swapper.join(timeout=60.0)
     after = client.access_log.windows()
     delta = after["served_requests"] - before["served_requests"]
 
@@ -134,6 +177,26 @@ def run_load(client, input_shape, offered: float, seconds: float,
                      ("device_ms", (50.0, 99.0))):
         window = after[name][-delta:] if delta > 0 else []
         record.update(percentile_summary(window, qs, prefix=f"{name}_p"))
+    if swapper is not None:
+        e2e = after["e2e_ms"][-delta:] if delta > 0 else []
+        tstamps = after["resolved_t"][-delta:] if delta > 0 else []
+        in_swap = [
+            v for v, t in zip(e2e, tstamps)
+            if any(ts <= t <= ts + swap_window_s for ts in swap_ts)
+        ]
+        steady = [
+            v for v, t in zip(e2e, tstamps)
+            if not any(ts <= t <= ts + swap_window_s for ts in swap_ts)
+        ]
+        record.update(
+            swaps=len(swap_ts),
+            swap_window_s=swap_window_s,
+            swap_requests=len(in_swap),
+            **percentile_summary(in_swap, (50.0, 99.0),
+                                 prefix="swap_e2e_ms_p"),
+            **percentile_summary(steady, (50.0, 99.0),
+                                 prefix="steady_e2e_ms_p"),
+        )
     return record
 
 
@@ -152,7 +215,17 @@ def main(argv=None) -> int:
                    help="images per request")
     p.add_argument("--warmup_requests", type=int, default=8,
                    help="requests served before timing starts")
+    p.add_argument("--reload_every", type=float, default=0.0,
+                   help="hot-swap the newest --ckpt_dir checkpoint every "
+                        "N seconds DURING each load (same-checkpoint "
+                        "swap: the numeric no-op / swap-cost probe); the "
+                        "record adds swap-window vs steady-state p99")
+    p.add_argument("--swap_window_s", type=float, default=0.5,
+                   help="window after each swap attributed to it in the "
+                        "swap-vs-steady latency split")
     args = p.parse_args(argv)
+    if args.reload_every > 0 and not args.ckpt_dir:
+        p.error("--reload_every needs --ckpt_dir (the watched directory)")
 
     # Inherited --obs_trace (server parser): every bench run can emit a
     # bucket-attributed serving trace for tools/obs_report.py.
@@ -160,6 +233,20 @@ def main(argv=None) -> int:
 
     obs.maybe_enable(args.obs_trace)
     client, input_shape = _build_client(args)
+    reloader = None
+    if args.reload_every > 0:
+        # The swap path under test is the real one: restore → adapt →
+        # cache factorization → plan placement → canary → atomic swap.
+        from dwt_tpu.fleet import CanaryGate, HotReloader
+
+        canary_x = np.random.default_rng(args.seed).normal(
+            size=(min(8, client.engine.buckets[-1]),) + tuple(input_shape)
+        ).astype(np.float32)
+        reloader = HotReloader(
+            client.engine, args.ckpt_dir,
+            access_log=client.access_log,
+            canary=CanaryGate(client.engine, canary_x),
+        )
     rng = np.random.default_rng(args.seed)
     warm = rng.normal(
         size=(args.request_n,) + tuple(input_shape)
@@ -173,6 +260,8 @@ def main(argv=None) -> int:
             record = run_load(
                 client, input_shape, offered, args.duration_s,
                 args.request_n, seed=args.seed,
+                reloader=reloader, reload_every_s=args.reload_every,
+                swap_window_s=args.swap_window_s,
             )
             print(json.dumps(record), flush=True)
     finally:
