@@ -548,6 +548,15 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         steps = min(args.steps, 5)
     if getattr(args, "obs_trace", None):
         model_args += ["--obs_trace", args.obs_trace]
+    if getattr(args, "compare", None):
+        # The gate rides the fallback too: a CPU rerun still compares
+        # against the baseline (like-for-like metric names make a TPU
+        # baseline vs CPU fallback report MISSING, which is the honest
+        # verdict).
+        model_args += [
+            "--compare", args.compare,
+            "--compare_tolerance", str(args.compare_tolerance),
+        ]
     cmd = [
         sys.executable,
         os.path.abspath(__file__),
@@ -604,6 +613,21 @@ def main():
         help="span tracing: write a Chrome trace-event JSON of the bench "
         "run's spans (H2D staging, dispatch waits) to this path for "
         "tools/obs_report.py; DWT_OBS_TRACE env is the flagless form",
+    )
+    ap.add_argument(
+        "--compare",
+        default=None,
+        metavar="BASELINE_JSON",
+        help="regression gate: after measuring, diff this run's record "
+        "against a stored baseline (e.g. BENCH_r05.json) through "
+        "tools/obs_diff.py — prints the delta table and exits nonzero "
+        "on regression (3) or a missing baseline metric (4)",
+    )
+    ap.add_argument(
+        "--compare_tolerance",
+        type=float,
+        default=5.0,
+        help="tolerance band in percent for --compare (default 5)",
     )
     ap.add_argument("--fallback-note", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -755,6 +779,35 @@ def main():
         record["fallback"] = args.fallback_note
     obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     print(json.dumps(record))
+    if args.compare:
+        # Route through the shared cross-run gate (tools/obs_diff.py):
+        # a bench run gates itself against a stored baseline in one
+        # command.  The record line above ALWAYS prints first, and the
+        # gate's table/summary go to STDERR — stdout keeps the repo's
+        # last-JSON-line-is-the-record contract (test_bench_contract
+        # consumers parse it that way), so the measurement is never
+        # lost to a gate verdict.
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools"),
+        )
+        import obs_diff
+
+        try:
+            rc = obs_diff.gate(
+                args.compare, record,
+                default_tolerance_pct=args.compare_tolerance,
+                out=sys.stderr,
+            )
+        except (OSError, ValueError) as e:
+            # A typo'd/unreadable baseline must not turn a finished
+            # multi-minute measurement into a traceback: diagnose and
+            # exit with obs_diff's unusable-input code.
+            print(f"bench: --compare failed: {e}", file=sys.stderr)
+            sys.exit(2)
+        if rc != 0:
+            sys.exit(rc)
 
 
 if __name__ == "__main__":

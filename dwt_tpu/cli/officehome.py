@@ -182,9 +182,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "cost ~one global read")
     p.add_argument("--heartbeat_every", type=int, default=d.heartbeat_every,
                    help=">0: emit a heartbeat record (steps/s EWMA, host "
-                        "RSS MB, async-ckpt in-flight depth) every N "
-                        "steps — the cheap always-on liveness signal "
-                        "when full tracing is off.  0 disables")
+                        "RSS MB, device memory, async-ckpt in-flight "
+                        "depth) every N steps — the cheap always-on "
+                        "liveness signal when full tracing is off.  "
+                        "0 disables")
+    p.add_argument("--metrics_port", type=int, default=d.metrics_port,
+                   help="live metrics plane: serve Prometheus text "
+                        "exposition at /metrics on this port (daemon "
+                        "thread; 0 = ephemeral, port logged as a "
+                        "metrics_exporter record)")
+    p.add_argument("--alert_rules", type=str, default=d.alert_rules,
+                   help="SLO alert rules JSON evaluated each step "
+                        "boundary against the live registry; fire/clear "
+                        "transitions emit 'alert' JSONL records and the "
+                        "dwt_alerts_firing gauge")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize bottleneck blocks in backward "

@@ -157,9 +157,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "cost ~one global read")
     p.add_argument("--heartbeat_every", type=int, default=d.heartbeat_every,
                    help=">0: emit a heartbeat record (steps/s EWMA, host "
-                        "RSS MB, async-ckpt in-flight depth) every N "
-                        "steps — the cheap always-on liveness signal "
-                        "when full tracing is off.  0 disables")
+                        "RSS MB, device memory, async-ckpt in-flight "
+                        "depth) every N steps — the cheap always-on "
+                        "liveness signal when full tracing is off.  "
+                        "0 disables")
+    p.add_argument("--metrics_port", type=int, default=d.metrics_port,
+                   help="live metrics plane: serve Prometheus text "
+                        "exposition at /metrics on this port (daemon "
+                        "thread; 0 = ephemeral, port logged as a "
+                        "metrics_exporter record).  Scrape steps/s, "
+                        "loss, guard events, checkpoint stalls mid-run")
+    p.add_argument("--alert_rules", type=str, default=d.alert_rules,
+                   help="SLO alert rules JSON (list of {name, metric, "
+                        "op, threshold, for_s, severity, labels}): "
+                        "evaluated each step boundary against the live "
+                        "registry; fire/clear transitions emit 'alert' "
+                        "JSONL records and the dwt_alerts_firing gauge")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--expect_accuracy", type=float, default=None,

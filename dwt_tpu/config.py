@@ -119,6 +119,15 @@ class DigitsConfig:
     # in-flight depth) every N steps — the cheap always-on liveness
     # signal when full tracing is off.  0 disables.
     heartbeat_every: int = 100
+    # Live metrics plane (dwt_tpu.obs.registry/prom): serve Prometheus
+    # text exposition at http://127.0.0.1:<port>/metrics on a daemon
+    # thread (0 = ephemeral port, logged as a metrics_exporter record).
+    # None = no exporter (the registry still accumulates for free).
+    metrics_port: Optional[int] = None
+    # SLO alert rules JSON (dwt_tpu.obs.rules): evaluated at step-
+    # boundary cadence against the live registry; fire/clear transitions
+    # become "alert" JSONL records and the dwt_alerts_firing gauge.
+    alert_rules: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -206,3 +215,7 @@ class OfficeHomeConfig:
     # heartbeat_every.
     obs_trace: Optional[str] = None
     heartbeat_every: int = 100
+    # Live metrics exporter / SLO alert rules — see DigitsConfig
+    # metrics_port / alert_rules.
+    metrics_port: Optional[int] = None
+    alert_rules: Optional[str] = None

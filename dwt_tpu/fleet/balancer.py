@@ -43,6 +43,7 @@ import time
 from http.server import ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
+from dwt_tpu.obs.registry import get_registry
 from dwt_tpu.serve.server import DrainAwareHandler
 
 log = logging.getLogger(__name__)
@@ -103,7 +104,22 @@ class Replica:
         self.outstanding = 0
         self.served = 0
         self.failures = 0          # lifetime proxy/probe failures
+        self.respawns = 0          # times this slot was re-spawned
         self.last_health: dict = {}
+
+    def replace_process(self, proc: subprocess.Popen, port: int,
+                        timeout: float = 70.0) -> None:
+        """Point this slot at a freshly spawned subprocess (respawn
+        policy): new port, fresh connection pool — the old pool's
+        connections name a dead port and would only feed the eject
+        path."""
+        old_pool = self.pool
+        self.proc = proc
+        self.port = int(port)
+        self.pool = _ConnPool(self.host, port, timeout)
+        self.last_health = {}
+        self.respawns += 1
+        old_pool.close_all()
 
     @property
     def pid(self) -> Optional[int]:
@@ -118,6 +134,7 @@ class Replica:
             "rid": self.rid, "port": self.port, "pid": self.pid,
             "healthy": self.healthy, "outstanding": self.outstanding,
             "served": self.served, "failures": self.failures,
+            "respawns": self.respawns,
             "version": self.last_health.get("version"),
         }
 
@@ -129,6 +146,22 @@ class ReplicaSet:
         self.replicas = list(replicas)
         self._lock = threading.Lock()
         self._rr = 0
+        # Live metrics plane: balancer-level series (the per-replica
+        # serving series ride the /metrics aggregation with a replica
+        # label — see _BalancerHandler).
+        reg = get_registry()
+        self._m_ejections = reg.counter(
+            "dwt_fleet_ejections_total",
+            "replica ejections from routing", labelnames=("rid",),
+        )
+        reg.gauge(
+            "dwt_fleet_healthy_replicas", "replicas currently routable"
+        ).set_function(self.healthy_count)
+        self._m_outstanding = reg.gauge(
+            "dwt_fleet_replica_outstanding",
+            "in-flight proxied requests per replica (scrape-time)",
+            labelnames=("rid",),
+        )
 
     def pick(self) -> Optional[Replica]:
         """Healthy replica with the fewest outstanding proxied requests
@@ -156,6 +189,7 @@ class ReplicaSet:
             replica.healthy = False
             replica.failures += 1
         if first:
+            self._m_ejections.labels(rid=str(replica.rid)).inc()
             log.warning("fleet: replica %d ejected (%s)",
                         replica.rid, reason)
 
@@ -174,20 +208,143 @@ class ReplicaSet:
         with self._lock:
             return [r.describe() for r in self.replicas]
 
+    def refresh_metrics(self) -> None:
+        """Re-stamp the per-replica gauges (scrape-time)."""
+        for d in self.describe():
+            self._m_outstanding.labels(rid=str(d["rid"])).set(
+                d["outstanding"]
+            )
+
+
+class Respawner:
+    """Re-spawn dead replica subprocesses with exponential backoff.
+
+    ``--respawn_max N``: each replica SLOT may be re-spawned at most N
+    times over the fleet's life (a crash-looping artifact must not burn
+    CPU forever); attempts back off exponentially
+    (``backoff_s × 2^(attempt-1)``) so a replica that dies on arrival
+    retries gently.  A successful respawn replaces the slot's process
+    and port and lets the next healthy probe re-admit it — closing the
+    ROADMAP fleet gap where a SIGKILLed replica stayed ejected and the
+    fleet silently shrank.
+
+    The spawn itself (subprocess start + ready-line wait, bounded by
+    ``ready_timeout_s``) runs on a BACKGROUND thread: the prober's pass
+    must keep probing the other replicas while a replacement compiles —
+    a wedged replica elsewhere must still be ejected on schedule.
+    ``spawn_fn``/``clock`` are injectable and ``background=False``
+    makes the spawn synchronous (unit tests drive the backoff with a
+    fake clock and a fake spawner).
+    """
+
+    def __init__(self, serve_argv: List[str], host: str = "127.0.0.1",
+                 max_respawns: int = 0, backoff_s: float = 1.0,
+                 ready_timeout_s: float = 120.0,
+                 spawn_fn=None, clock=time.monotonic,
+                 background: bool = True):
+        self.serve_argv = list(serve_argv)
+        self.host = host
+        self.max_respawns = int(max_respawns)
+        self.backoff_s = float(backoff_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self._spawn_fn = spawn_fn or (
+            lambda rid, argv, h: spawn_replica(
+                rid, argv, h, ready_timeout_s=self.ready_timeout_s
+            )
+        )
+        self._clock = clock
+        self.background = background
+        self._attempts: dict = {}      # rid -> attempts so far
+        self._next_due: dict = {}      # rid -> earliest next attempt
+        self._in_progress: set = set()  # rids with a spawn thread live
+        self._exhausted_logged: set = set()
+        self._m_respawns = get_registry().counter(
+            "dwt_fleet_respawns_total",
+            "replica subprocess respawns", labelnames=("rid",),
+        )
+
+    def maybe_respawn(self, replica: Replica) -> bool:
+        """Called by the prober on a dead replica.  Quick no-op while a
+        spawn is already in flight, the backoff holds, or the budget is
+        exhausted; otherwise launches the respawn (background thread by
+        default — the prober must not stall on a slow-compiling
+        replacement).  Returns True only when a SYNCHRONOUS spawn
+        completed (``background=False``)."""
+        rid = replica.rid
+        if rid in self._in_progress:
+            return False
+        attempts = self._attempts.get(rid, 0)
+        if attempts >= self.max_respawns:
+            if rid not in self._exhausted_logged:
+                self._exhausted_logged.add(rid)
+                log.error(
+                    "fleet: replica %d dead and respawn budget (%d) "
+                    "exhausted; slot stays ejected", rid,
+                    self.max_respawns,
+                )
+            return False
+        now = self._clock()
+        if now < self._next_due.get(rid, 0.0):
+            return False
+        self._attempts[rid] = attempts + 1
+        self._next_due[rid] = now + self.backoff_s * (2 ** attempts)
+        if not self.background:
+            return self._spawn_into(replica, attempts + 1)
+        self._in_progress.add(rid)
+        threading.Thread(
+            target=self._spawn_into, args=(replica, attempts + 1),
+            name=f"dwt-fleet-respawn-{rid}", daemon=True,
+        ).start()
+        return False
+
+    def _spawn_into(self, replica: Replica, attempt: int) -> bool:
+        rid = replica.rid
+        # _in_progress clears only AFTER the slot swap: released between
+        # the spawn and replace_process, a probe tick in that window
+        # would see the old dead proc and launch a duplicate spawn —
+        # two fresh subprocesses racing for one slot, the loser orphaned
+        # forever on a port nothing routes to.
+        try:
+            try:
+                fresh = self._spawn_fn(rid, self.serve_argv, self.host)
+            except Exception as e:
+                log.warning(
+                    "fleet: respawn of replica %d failed (attempt "
+                    "%d/%d): %s", rid, attempt, self.max_respawns, e,
+                )
+                return False
+            replica.replace_process(fresh.proc, fresh.port)
+            self._m_respawns.labels(rid=str(rid)).inc()
+            log.info(
+                "fleet: replica %d respawned on port %d (attempt %d/%d)",
+                rid, replica.port, attempt, self.max_respawns,
+            )
+            # The next healthy probe re-admits it; routing needs no help.
+            return True
+        finally:
+            self._in_progress.discard(rid)
+
 
 class HealthProber(threading.Thread):
     """Periodic /healthz probe per replica: eject on failure, re-admit
-    on recovery.  A dead subprocess is ejected permanently (its port
-    answers nothing; re-admission would need a respawn policy — out of
-    scope, the fleet keeps serving on the survivors)."""
+    on recovery.  A dead subprocess is ejected and — when a
+    :class:`Respawner` is armed (``--respawn_max``) — re-spawned with
+    exponential backoff; without one it stays ejected permanently and
+    the fleet keeps serving on the survivors."""
 
     def __init__(self, replicas: ReplicaSet, interval_s: float = 1.0,
-                 timeout_s: float = 2.0, max_heartbeat_age_s: float = 30.0):
+                 timeout_s: float = 2.0, max_heartbeat_age_s: float = 30.0,
+                 respawner: Optional[Respawner] = None):
         super().__init__(name="dwt-fleet-health", daemon=True)
         self.replicas = replicas
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
         self.max_heartbeat_age_s = float(max_heartbeat_age_s)
+        self.respawner = respawner
+        self._m_probe_failures = get_registry().counter(
+            "dwt_fleet_probe_failures_total",
+            "failed /healthz probes", labelnames=("rid",),
+        )
         # NB: not `_stop` — threading.Thread has a private method of
         # that name and shadowing it breaks join().
         self._stop_evt = threading.Event()
@@ -198,6 +355,12 @@ class HealthProber(threading.Thread):
                 self.replicas.eject(
                     r, f"process exited rc={r.proc.returncode}"
                 )
+                if self.respawner is not None:
+                    # Launches the spawn on a background thread: the
+                    # prober keeps probing the OTHER replicas while the
+                    # replacement compiles (a wedged replica elsewhere
+                    # must still be ejected on schedule).
+                    self.respawner.maybe_respawn(r)
                 continue
             conn = None
             try:
@@ -208,6 +371,7 @@ class HealthProber(threading.Thread):
                 resp = conn.getresponse()
                 body = json.loads(resp.read() or b"{}")
             except (OSError, http.client.HTTPException, ValueError) as e:
+                self._m_probe_failures.labels(rid=str(r.rid)).inc()
                 self.replicas.eject(r, f"probe failed: {e}")
                 continue
             finally:
@@ -248,6 +412,20 @@ class HealthProber(threading.Thread):
 
 
 # --------------------------------------------------------------- HTTP front
+
+_PROXIED = None
+
+
+def _proxied_counter():
+    global _PROXIED
+    if _PROXIED is None:
+        _PROXIED = get_registry().counter(
+            "dwt_fleet_proxied_total",
+            "requests proxied to replicas by status class",
+            labelnames=("status",),
+        )
+    return _PROXIED
+
 
 class _BalancerHandler(DrainAwareHandler):
     """The balancer's front end: the serve handler's keep-alive/drain
@@ -303,6 +481,9 @@ class _BalancerHandler(DrainAwareHandler):
                 continue  # safe retry on another replica
             replica.pool.put(conn)
             self.replicas.release(replica, ok=resp.status == 200)
+            _proxied_counter().labels(
+                status=f"{resp.status // 100}xx"
+            ).inc()
             self.send_response(resp.status)
             self.send_header("Content-Type", "application/jsonl")
             self.send_header("Content-Length", str(len(data)))
@@ -360,8 +541,58 @@ class _BalancerHandler(DrainAwareHandler):
                 except (OSError, http.client.HTTPException, ValueError):
                     pass
             self._reply(200, out)
+        elif self.path == "/metrics":
+            self._reply_metrics()
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def _reply_metrics(self) -> None:
+        """Fleet-aggregating exposition: the balancer's own registry
+        (routing, ejections, respawns, probe failures) merged with every
+        HEALTHY replica's /metrics, each replica's samples re-labeled
+        ``replica="<rid>"`` — one scrape tells the whole fleet's story.
+        An unreachable replica contributes nothing (its absence IS the
+        signal; ``dwt_fleet_healthy_replicas`` says so explicitly)."""
+        import concurrent.futures
+
+        from dwt_tpu.obs import prom
+
+        self.replicas.refresh_metrics()
+
+        def fetch(r: Replica):
+            try:
+                conn = http.client.HTTPConnection(
+                    r.host, r.port, timeout=2.0
+                )
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                log.warning(
+                    "fleet: /metrics passthrough from replica %d "
+                    "failed: %s", r.rid, e,
+                )
+                return None
+            return text if resp.status == 200 else None
+
+        # Fetch replicas CONCURRENTLY: slow-but-listening replicas each
+        # burn their full 2 s timeout, and a sequential pass over a
+        # degraded fleet would blow a scraper's own deadline exactly
+        # when the fleet view matters most — the scrape is bounded by
+        # the slowest single replica, not the sum.
+        healthy = [r for r in self.replicas.replicas if r.healthy]
+        parts = [({}, prom.render())]
+        if healthy:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(healthy))
+            ) as pool:
+                for r, text in zip(healthy, pool.map(fetch, healthy)):
+                    if text is not None:
+                        parts.append(({"replica": str(r.rid)}, text))
+        self._reply_text(
+            200, prom.merge_expositions(parts), prom.CONTENT_TYPE
+        )
 
 
 def make_handler(replicas: ReplicaSet, draining: threading.Event):
@@ -459,6 +690,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="eject a replica whose dispatcher heartbeat age "
                         "exceeds this while work is queued (wedged-but-"
                         "listening)")
+    p.add_argument("--respawn_max", type=int, default=0,
+                   help=">0: re-spawn a dead (e.g. SIGKILLed) replica "
+                        "subprocess up to this many times per slot, "
+                        "with exponential backoff, instead of leaving "
+                        "it permanently ejected.  0 = legacy behavior "
+                        "(the fleet survives but shrinks)")
+    p.add_argument("--respawn_backoff_s", type=float, default=1.0,
+                   help="base respawn backoff; attempt k waits "
+                        "backoff * 2^(k-1) after the previous attempt")
     return p
 
 
@@ -484,9 +724,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 r.proc.kill()
         raise
     rset = ReplicaSet(replicas)
+    respawner = None
+    if args.respawn_max > 0:
+        respawner = Respawner(
+            serve_argv, host=args.host,
+            max_respawns=args.respawn_max,
+            backoff_s=args.respawn_backoff_s,
+        )
     prober = HealthProber(
         rset, args.health_interval_s,
         max_heartbeat_age_s=args.max_heartbeat_age_s,
+        respawner=respawner,
     )
     prober.start()
 

@@ -37,6 +37,11 @@ from dwt_tpu.serve.engine import EngineState, ServeEngine
 log = logging.getLogger(__name__)
 
 
+# Per-version window stats with a pre-swap baseline the monitor arms —
+# the only metrics a --rollback_rules baseline_factor may reference.
+_BASELINE_METRICS = ("e2e_ms_p99",)
+
+
 @dataclass(frozen=True)
 class CanaryVerdict:
     ok: bool
@@ -135,7 +140,20 @@ class PostSwapMonitor:
     * ``None`` — undecided (window too small, still inside the grace
       period);
     * ``"ok"`` — the new version held: window served clean;
-    * ``"rollback: …"`` — error rate or p99 regressed past threshold.
+    * ``"rollback: …"`` — a trip rule fired on the version's window.
+
+    The trip conditions are declarative :class:`~dwt_tpu.obs.rules
+    .AlertRule` objects evaluated against the version's stats dict
+    (keys: ``served``/``errors``/``error_rate``/``e2e_ms_p50``/
+    ``e2e_ms_p99``).  The default rule set reproduces the two historical
+    hardcoded conditions exactly (error rate over threshold; p99 past
+    ``p99_factor`` × the armed baseline); ``rules=`` replaces them with
+    an operator-supplied set (``--rollback_rules`` on ``dwt-serve``),
+    where a ``baseline_factor`` threshold resolves against the pre-swap
+    baseline of the same metric.  Rules on ``error_rate`` additionally
+    get the FAST trip: they are checked from a quarter window (even a
+    small all-errors window is a clear regression — don't wait out the
+    grace period serving 500s).
 
     ``clock`` is injectable (fake-clock tests, the repo convention).
     """
@@ -149,12 +167,44 @@ class PostSwapMonitor:
         min_requests: int = 50,
         decide_after_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        rules=None,
     ):
+        from dwt_tpu.obs.rules import AlertRule
+
         self.access_log = access_log
         self.error_rate_threshold = float(error_rate_threshold)
         self.p99_factor = float(p99_factor)
         self.min_requests = int(min_requests)
         self.decide_after_s = float(decide_after_s)
+        if rules is not None:
+            # Fail at construction, not silently at verdict time: a
+            # baseline_factor rule can only resolve against baselines
+            # this monitor actually arms (today: the pre-swap e2e p99).
+            # An inert custom gate is the exact failure mode the rules
+            # surface exists to remove.
+            for r in rules:
+                if (r.baseline_factor is not None
+                        and r.metric not in _BASELINE_METRICS):
+                    raise ValueError(
+                        f"rollback rule {r.name!r}: baseline_factor "
+                        f"needs a metric with an armed baseline "
+                        f"{_BASELINE_METRICS}; {r.metric!r} has none — "
+                        "use an absolute threshold"
+                    )
+        self.rules = list(rules) if rules is not None else [
+            # The two historical trip conditions, now data.  Order
+            # matters: the p99 rule reports first at the full window
+            # (matching the pre-rules behavior and its tests).
+            AlertRule(
+                name="post_swap_p99", metric="e2e_ms_p99", op=">",
+                baseline_factor=self.p99_factor, severity="critical",
+            ),
+            AlertRule(
+                name="post_swap_error_rate", metric="error_rate",
+                op=">", threshold=self.error_rate_threshold,
+                severity="critical",
+            ),
+        ]
         self._clock = clock
         self._armed = False
         self._version: Optional[str] = None
@@ -176,39 +226,40 @@ class PostSwapMonitor:
         self._armed = False
         self._version = None
 
+    def _baselines(self) -> dict:
+        """Pre-swap baselines a ``baseline_factor`` rule resolves
+        against — today the old version's e2e p99 armed at swap time."""
+        if self._baseline_p99 is None:
+            return {}
+        return {"e2e_ms_p99": self._baseline_p99}
+
     def verdict(self) -> Optional[str]:
+        from dwt_tpu.obs.rules import rule_fires
+
         if not self._armed:
             return None
         stats = self.access_log.version_stats(self._version)
         total = stats.get("served", 0) + stats.get("errors", 0)
-        # Errors are a fast trip: even a small all-errors window is a
-        # clear regression — don't wait out the grace period serving 500s.
-        if (total >= max(8, self.min_requests // 4)
-                and stats.get("error_rate", 0.0)
-                > self.error_rate_threshold):
-            return (
-                f"rollback: error_rate {stats['error_rate']:.3f} > "
-                f"{self.error_rate_threshold} over {total} requests"
-            )
+        baselines = self._baselines()
+        # Error-rate rules are a fast trip: even a small all-errors
+        # window is a clear regression — don't wait out the grace period
+        # serving 500s.
+        if total >= max(8, self.min_requests // 4):
+            for rule in self.rules:
+                if rule.metric != "error_rate":
+                    continue
+                fired = rule_fires(rule, stats, baselines)
+                if fired:
+                    return f"rollback: {fired} over {total} requests"
         if total < self.min_requests:
             if (self._clock() - self._t_swap) >= self.decide_after_s:
-                # Grace period over with a thin window and no error
+                # Grace period over with a thin window and no fast
                 # trip: hold the version (an idle server must not be
                 # forced back forever).
                 return "ok"
             return None
-        if (self._baseline_p99 is not None
-                and stats.get("e2e_ms_p99") is not None
-                and stats["e2e_ms_p99"]
-                > self.p99_factor * self._baseline_p99):
-            return (
-                f"rollback: e2e p99 {stats['e2e_ms_p99']:.1f} ms > "
-                f"{self.p99_factor}x baseline "
-                f"{self._baseline_p99:.1f} ms"
-            )
-        if stats.get("error_rate", 0.0) > self.error_rate_threshold:
-            return (
-                f"rollback: error_rate {stats['error_rate']:.3f} > "
-                f"{self.error_rate_threshold}"
-            )
+        for rule in self.rules:
+            fired = rule_fires(rule, stats, baselines)
+            if fired:
+                return f"rollback: {fired}"
         return "ok"

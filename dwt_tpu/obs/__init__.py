@@ -53,3 +53,12 @@ from dwt_tpu.obs.export import (  # noqa: F401
     to_chrome_trace,
     validate_chrome_trace,
 )
+# Live metrics plane (ISSUE-12): the always-on registry every subsystem
+# feeds (counters/gauges/histograms), the Prometheus text exposition +
+# exporters in dwt_tpu.obs.prom, and the SLO alert engine in
+# dwt_tpu.obs.rules.  Submodules import lazily at call sites that need
+# them; the registry itself is dependency-free and cheap to load.
+from dwt_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+)

@@ -72,6 +72,19 @@ from dwt_tpu import obs
 log = logging.getLogger(__name__)
 
 
+def _count_save_failure(kind: str) -> None:
+    """Live-metrics feed for writer/promotion failures: the error also
+    surfaces on the next save/flush, but an operator scraping /metrics
+    sees the counter move the moment the background half fails."""
+    from dwt_tpu.obs.registry import get_registry
+
+    get_registry().counter(
+        "dwt_ckpt_save_failures_total",
+        "checkpoint writer/promotion failures",
+        labelnames=("kind",),
+    ).labels(kind=kind).inc()
+
+
 # One compiled whole-tree copy, not per-leaf eager jnp.copy: eager dispatch
 # of ~75 small ops contends with a busy compute queue (measured: the
 # per-leaf form stalls 15→170 ms as the dispatch queue deepens; the jitted
@@ -130,6 +143,7 @@ class AsyncCheckpointer:
         except BaseException as e:  # surfaced on the next enqueue/flush
             self._error = e
             self._error_step = step
+            _count_save_failure("write")
             log.warning("async checkpoint save @%d failed: %s", step, e)
 
     def _join(self) -> None:
@@ -280,6 +294,7 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         except BaseException as e:  # surfaced on the next enqueue/flush
             self._error = e
             self._error_step = step
+            _count_save_failure("shard_write")
             log.warning("async shard save @%d failed: %s", step, e)
 
     # ------------------------------------------------------------------ API
@@ -348,6 +363,7 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
                 if self._error is None:
                     self._error = e
                     self._error_step = step
+                _count_save_failure("promote")
                 log.warning("checkpoint promotion @%d failed: %s", step, e)
 
     def flush(self):

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import IO, Optional
 
+from dwt_tpu.obs.registry import get_registry
 from dwt_tpu.utils.metrics import percentile_summary
 
 log = logging.getLogger(__name__)
@@ -103,6 +104,39 @@ class AccessLog:
         self._versions: "collections.OrderedDict[str, _VersionStats]" = \
             collections.OrderedDict()
         self._write_failed = False  # warn once, not per record
+        # Disk-full drops were warn-once and then INVISIBLE: count every
+        # lost record so summary()/ /stats / /metrics keep reporting the
+        # hole long after the one log line scrolled away.
+        self.lost_records = 0
+        # Live metrics plane: request counters + per-bucket latency
+        # histograms on the process-wide registry (get-or-create is
+        # idempotent, so many AccessLog instances share the families;
+        # children are cached per instance — the record() hot path pays
+        # one dict lookup + a locked add per sample).
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "dwt_serve_requests_total", "serving requests by outcome",
+            labelnames=("status",),
+        )
+        self._m_imgs = reg.counter(
+            "dwt_serve_imgs_total", "samples served (ok requests)"
+        )
+        self._m_lost = reg.counter(
+            "dwt_serve_lost_log_records_total",
+            "access-log records dropped by failed writes (disk full)",
+        )
+        self._m_lat = {
+            phase: reg.histogram(
+                f"dwt_serve_{phase}_ms",
+                f"per-request {phase} latency by compiled bucket (ms)",
+                labelnames=("bucket",),
+            )
+            for phase in ("e2e", "queue", "device")
+        }
+        self._m_req_children = {
+            s: self._m_requests.labels(status=s)
+            for s in ("ok", "shed", "error")
+        }
 
     def _version_stats_locked(self, version: str) -> _VersionStats:
         vs = self._versions.get(version)
@@ -118,6 +152,21 @@ class AccessLog:
             for k, v in fields.items()
         }}
         version = fields.get("version")
+        # Registry feed outside the lock: the counters/histograms carry
+        # their own per-child locks, and nothing here reads AccessLog
+        # state.
+        child = self._m_req_children.get(status)
+        (child if child is not None
+         else self._m_requests.labels(status=status)).inc()
+        if status == "ok":
+            self._m_imgs.inc(int(n))
+            bucket = str(fields.get("bucket", ""))
+            for phase in ("e2e", "queue", "device"):
+                v = fields.get(f"{phase}_ms")
+                if v is not None:
+                    self._m_lat[phase].labels(bucket=bucket).observe(
+                        float(v)
+                    )
         with self._lock:
             if status == "ok":
                 self.served_requests += 1
@@ -162,17 +211,25 @@ class AccessLog:
         # access records — not to a dead dispatcher that sheds all
         # traffic while inference itself is healthy.
         line = json.dumps(rec) + "\n"
+        lost = False
         for sink in (self._file, self._stream):
             if sink is not None:
                 try:
                     sink.write(line)
                 except (OSError, ValueError) as e:
+                    lost = True
                     if not self._write_failed:
                         self._write_failed = True
                         log.warning(
                             "access-log write failed (%s); further "
                             "records may be lost", e,
                         )
+        if lost:
+            # Warn once, COUNT always: the drop stays visible in
+            # summary(), /stats, and the /metrics counter after the one
+            # warning scrolled away.
+            self.lost_records += 1
+            self._m_lost.inc()
 
     def version_stats(self, version: str) -> dict:
         """Aggregates attributed to ONE served version: the post-swap
@@ -213,6 +270,7 @@ class AccessLog:
                 "imgs_per_s": round(
                     self.served_imgs / max(seconds, 1e-9), 1
                 ),
+                "lost_log_records": self.lost_records,
             }
             windows = [
                 ("e2e_ms", list(self._e2e_ms)),

@@ -50,6 +50,11 @@ from dwt_tpu.serve.batcher import (
 from dwt_tpu.serve.engine import ServeEngine
 from dwt_tpu.serve.metrics import AccessLog
 
+# Shared with the training heartbeat since ISSUE-12 (HBM growth must be
+# visible during training too); the old module-local name is kept for
+# callers/tests.
+from dwt_tpu.utils.metrics import device_memory_stats as _device_memory_stats
+
 log = logging.getLogger(__name__)
 
 
@@ -287,7 +292,48 @@ class ServeClient:
             engine, self.batcher, self.access_log, staging_depth
         )
         self._t0 = time.monotonic()
+        # Live metrics: callback gauges sampled at scrape time — the
+        # queue/in-flight/liveness quantities already have owners, so
+        # /metrics reads them instead of a second bookkeeping path.
+        # Re-registering overwrites the callback: the newest client in
+        # a process (tests build several) owns the gauges.
+        from dwt_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        reg.gauge(
+            "dwt_serve_queue_depth", "samples queued for dispatch"
+        ).set_function(lambda: self.batcher.queued_items)
+        reg.gauge(
+            "dwt_serve_in_flight_batches",
+            "batches staged/computing but unresolved",
+        ).set_function(lambda: self._dispatcher.in_flight_count)
+        reg.gauge(
+            "dwt_serve_dispatcher_heartbeat_age_s",
+            "seconds since the dispatcher last showed liveness",
+        ).set_function(lambda: self.dispatcher_heartbeat_age_s)
+        reg.gauge(
+            "dwt_serve_uptime_s", "seconds since this client started"
+        ).set_function(lambda: time.monotonic() - self._t0)
+        self._m_version = reg.gauge(
+            "dwt_serve_version",
+            "currently served checkpoint generation (value is always 1)",
+            labelnames=("version",),
+        )
+        self._m_swaps = reg.gauge(
+            "dwt_serve_swap_count", "hot swaps since process start"
+        )
         self._dispatcher.start()
+
+    def refresh_version_metrics(self) -> None:
+        """Re-stamp the served-version info gauge (scrape-time: a swap
+        may have landed since the last scrape, and the stale label must
+        stop being exported)."""
+        version = getattr(self.engine, "version", None)
+        if version is None:
+            return
+        self._m_version.clear()
+        self._m_version.labels(version=version.label).set(1)
+        self._m_swaps.set(getattr(self.engine, "swap_count", 0))
 
     @property
     def dispatcher_alive(self) -> bool:
@@ -354,18 +400,6 @@ class ServeClient:
             raise RuntimeError("serving dispatcher did not drain in time")
 
 
-def _device_memory_stats() -> Optional[dict]:
-    """Device 0's allocator stats (bytes in use / limit / peak) where the
-    backend exposes them (TPU/GPU do; CPU returns None).  Never raises —
-    /stats must answer whatever the backend's mood."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return None
-    if not stats:
-        return None
-    return {k: int(v) for k, v in stats.items()
-            if isinstance(v, (int, float))}
 
 
 class HttpServeClient:
@@ -528,6 +562,15 @@ class DrainAwareHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code: int, body: str, content_type: str) -> None:
+        """Non-JSON reply (the /metrics Prometheus exposition)."""
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
 
 class _Handler(DrainAwareHandler):
     # Set by _make_handler:
@@ -565,6 +608,15 @@ class _Handler(DrainAwareHandler):
             })
         elif self.path == "/stats":
             self._reply(200, self.client.stats())
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the process-wide registry:
+            # access counters/latency histograms, queue/liveness callback
+            # gauges, the served-version info gauge (re-stamped here so a
+            # swap since the last scrape updates the label).
+            from dwt_tpu.obs import prom
+
+            self.client.refresh_version_metrics()
+            self._reply_text(200, prom.render(), prom.CONTENT_TYPE)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -762,6 +814,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rollback_decide_s", type=float, default=30.0,
                    help="post-swap grace period: with a thin window and "
                         "no error trip, hold the version after this long")
+    p.add_argument("--rollback_rules", default=None,
+                   help="SLO rules JSON replacing the two built-in "
+                        "post-swap trip conditions: each rule's metric "
+                        "names a per-version access-window stat (served, "
+                        "errors, error_rate, e2e_ms_p50, e2e_ms_p99); "
+                        "baseline_factor thresholds resolve against the "
+                        "pre-swap baseline armed at swap time")
     p.add_argument("--data_parallel", action="store_true",
                    help="shard every bucket over all local devices (data "
                         "mesh replica fan-out)")
@@ -807,6 +866,11 @@ def build_reloader(args, engine, access_log):
     serve package and a module-level import would cycle."""
     from dwt_tpu.fleet import CanaryGate, HotReloader, PostSwapMonitor
 
+    rollback_rules = None
+    if getattr(args, "rollback_rules", None):
+        from dwt_tpu.obs.rules import load_rules
+
+        rollback_rules = load_rules(args.rollback_rules)
     x, y = load_canary_fixture(args, engine.input_shape)
     return HotReloader(
         engine, args.ckpt_dir,
@@ -821,6 +885,7 @@ def build_reloader(args, engine, access_log):
             p99_factor=args.rollback_p99_factor,
             min_requests=args.rollback_min_requests,
             decide_after_s=args.rollback_decide_s,
+            rules=rollback_rules,
         ),
     )
 

@@ -21,6 +21,7 @@ import contextlib
 import json
 import logging
 import os
+import time
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -352,6 +353,66 @@ def _params_digest(state: TrainState) -> float:
     return total
 
 
+# --------------------------------------------------- live metrics plane
+
+_LOSS_GAUGE = None
+_ACC_GAUGE = None
+
+
+def _note_losses(**losses) -> None:
+    """Feed the last logged training losses into the live registry.
+
+    Called at the loops' existing log-cadence sites, AFTER logger.log has
+    already forced the device scalars to host — the float() here re-reads
+    a materialized value, so the gauge feed adds no device sync."""
+    global _LOSS_GAUGE
+    if _LOSS_GAUGE is None:
+        from dwt_tpu.obs.registry import get_registry
+
+        _LOSS_GAUGE = get_registry().gauge(
+            "dwt_train_loss", "last logged training loss",
+            labelnames=("loss",),
+        )
+    for name, value in losses.items():
+        _LOSS_GAUGE.labels(loss=name).set(float(value))
+
+
+def _note_accuracy(acc: float) -> None:
+    global _ACC_GAUGE
+    if _ACC_GAUGE is None:
+        from dwt_tpu.obs.registry import get_registry
+
+        _ACC_GAUGE = get_registry().gauge(
+            "dwt_eval_accuracy", "last eval-pass target accuracy (%)"
+        )
+    _ACC_GAUGE.set(float(acc))
+
+
+def _setup_metrics_plane(cfg, logger):
+    """The run's live metrics surface (ISSUE-12): start the
+    ``--metrics_port`` /metrics exporter thread (0 = ephemeral; the
+    bound port is logged as a ``metrics_exporter`` record so tests and
+    operators can find it) and build the ``--alert_rules`` engine the
+    step boundary evaluates.  Returns the engine (or None)."""
+    port = getattr(cfg, "metrics_port", None)
+    if port is not None:
+        from dwt_tpu.obs import prom
+
+        exporter = prom.start_exporter(int(port))
+        logger.log(
+            "metrics_exporter", 0, port=exporter.server_address[1]
+        )
+    rules_path = getattr(cfg, "alert_rules", None)
+    if not rules_path:
+        return None
+    from dwt_tpu.obs import rules as obs_rules
+
+    engine = obs_rules.AlertEngine(obs_rules.load_rules(rules_path))
+    logger.log("alert_rules", 0, rules=len(engine.rules),
+               path=rules_path)
+    return engine
+
+
 def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
     policy = getattr(cfg, "guard_policy", "none") or "none"
     backoff = getattr(cfg, "guard_lr_backoff", 0.0) or 0.0
@@ -374,6 +435,13 @@ def _make_guard(cfg, logger) -> Optional[DivergenceGuard]:
         backoff_recovery=getattr(cfg, "guard_backoff_recovery", 3),
     )
 
+
+# Guard event codes -> the dwt_guard_events_total{event=} label values.
+_EVENT_METRIC_NAMES = {
+    EVENT_RECOVERED: "recovered",
+    EVENT_ROLLBACK: "rollback",
+    EVENT_HALT: "halt",
+}
 
 # Consensus decision records ("consensus" kind) aggregate this many
 # decide() calls per emitted line: every boundary would drown the JSONL
@@ -409,7 +477,7 @@ class _StepBoundary:
 
     def __init__(self, guard, preempt, coord, watchdog, logger=None,
                  ckpt=None, notice_watcher=None, heartbeat=None,
-                 flight_dir=None):
+                 flight_dir=None, alerts=None):
         self.guard = guard
         self.preempt = preempt
         self.coord = coord
@@ -417,6 +485,21 @@ class _StepBoundary:
         self.logger = logger
         self.ckpt = ckpt
         self.notice_watcher = notice_watcher
+        # Live metrics plane: step/guard counters plus the --alert_rules
+        # engine, evaluated once per boundary (internally throttled).
+        # Counter feed is host-side integers only — no device syncs.
+        from dwt_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._m_steps = reg.counter(
+            "dwt_train_steps_total", "optimizer steps completed"
+        )
+        self._m_guard = reg.counter(
+            "dwt_guard_events_total",
+            "divergence-guard events by rung (local or remote-mirrored)",
+            labelnames=("event",),
+        )
+        self.alerts = alerts
         # Periodic "heartbeat" record (utils.metrics.HeartbeatEmitter):
         # the always-on liveness signal when span tracing is off.
         self.heartbeat = heartbeat
@@ -484,10 +567,30 @@ class _StepBoundary:
         with obs.span("boundary"):
             return self._run(state, metrics, n_steps, gstep)
 
+    def _evaluate_alerts(self, gstep: int) -> None:
+        """Boundary-cadence SLO evaluation: fire/clear transitions ride
+        the metric stream as ``alert`` records (sync=True — an alert that
+        narrates a failing run must survive the run dying).  An engine
+        bug must not take training down: evaluation failures degrade to
+        a warning."""
+        try:
+            events = self.alerts.maybe_evaluate()
+        except Exception as e:
+            log.warning("alert evaluation failed: %s", e)
+            return
+        if self.logger is not None:
+            for ev in events:
+                self.logger.log(
+                    "alert", gstep, sync=True, **ev.record_fields()
+                )
+
     def _run(self, state, metrics, n_steps: int, gstep: int):
         self.watchdog.heartbeat()
+        self._m_steps.inc(n_steps)
         if self.heartbeat is not None:
             self.heartbeat.step(gstep)
+        if self.alerts is not None:
+            self._evaluate_alerts(gstep)
         # Control faults fire between the heartbeat and the guard so an
         # injected hang is measured from a fresh beat and an injected
         # SIGTERM is visible to this very boundary's stop flag.
@@ -508,6 +611,7 @@ class _StepBoundary:
             except DivergenceError as e:
                 event, code = e, EVENT_HALT
         if event is not None or code == EVENT_RECOVERED:
+            self._m_guard.labels(event=_EVENT_METRIC_NAMES[code]).inc()
             # Flight recorder: a guard event's post-mortem wants the last
             # seconds of spans — what every thread had been DOING —
             # dumped before any recovery path mutates the run's state.
@@ -552,6 +656,9 @@ class _StepBoundary:
                 # preceded the collective, e.g. a host-local data NaN, or
                 # its ladder escalated further): mirror the remote rung so
                 # the replicated state stays identical on every process.
+                self._m_guard.labels(
+                    event="remote_" + _EVENT_METRIC_NAMES[decision.event]
+                ).inc()
                 self._flight(f"remote_guard_event_step{gstep}")
                 if decision.event == EVENT_ROLLBACK and self.guard is not None:
                     # Keep the rollback budget and the re-seed stride in
@@ -615,6 +722,21 @@ class _CkptPipeline:
 
     def __init__(self, cfg, coord: Optional[Coordinator] = None, plan=None):
         self._coord = coord
+        # Live metrics: the per-save hot-path stall (enqueue on the async
+        # path, the whole blocking save on the sync path) and a save
+        # counter — the scrapeable twin of tools/ckpt_bench.py's numbers.
+        from dwt_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._m_saves = reg.counter(
+            "dwt_ckpt_saves_total", "checkpoint saves initiated",
+            labelnames=("mode",),
+        )
+        self._m_stall = reg.histogram(
+            "dwt_ckpt_stall_ms",
+            "hot-path stall per checkpoint save (async: snapshot + "
+            "enqueue incl. backpressure; sync: the full blocking save)",
+        )
         use_async = bool(cfg.ckpt_dir) and getattr(cfg, "async_ckpt", True)
         # State-sharding plans (model axis OR an FSDP-style custom table
         # sharding weights over data/dcn) gather their sharded leaves
@@ -651,6 +773,7 @@ class _CkptPipeline:
         backpressure join); on the sync path it books the full blocking
         ``save_state`` — the attribution report shows exactly which one
         a run paid."""
+        t0 = time.perf_counter()
         with obs.span("ckpt_enqueue", step=int(step)):
             if self._acp is not None:
                 self._acp.save_multi(targets, step, state)
@@ -659,6 +782,10 @@ class _CkptPipeline:
                     state = self._gather(state)
                 for ckpt_dir, kwargs in targets:
                     save_state(ckpt_dir, step, state, **kwargs)
+        self._m_saves.labels(
+            mode="async" if self._acp is not None else "sync"
+        ).inc()
+        self._m_stall.observe((time.perf_counter() - t0) * 1e3)
 
     def save_sync(self, ckpt_dir: str, step: int, state, **kwargs):
         """Join any in-flight save, then save on THIS thread and return
@@ -892,6 +1019,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
     obs.maybe_enable(getattr(cfg, "obs_trace", None))
+    alert_engine = _setup_metrics_plane(cfg, logger)
     _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
     if cfg.group_size == 32:
@@ -1039,6 +1167,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 os.path.join(cfg.ckpt_dir, "watchdog") if cfg.ckpt_dir
                 else None
             ),
+            alerts=alert_engine,
         )
 
         def _proactive_save(st):
@@ -1108,6 +1237,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                                     cls_loss=metrics["cls_loss"],
                                     entropy_loss=metrics["entropy_loss"],
                                 )
+                                _note_losses(
+                                    cls_loss=metrics["cls_loss"],
+                                    entropy_loss=metrics["entropy_loss"],
+                                )
                         state, stop = boundary(state, metrics, 1, gstep)
                         if stop:
                             break
@@ -1136,6 +1269,10 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                                         "train",
                                         step0 + j + 1,
                                         epoch=epoch,
+                                        cls_loss=ms["cls_loss"][jj],
+                                        entropy_loss=ms["entropy_loss"][jj],
+                                    )
+                                    _note_losses(
                                         cls_loss=ms["cls_loss"][jj],
                                         entropy_loss=ms["entropy_loss"][jj],
                                     )
@@ -1241,6 +1378,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 result = evalp.evaluate(state, target_test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
+            _note_accuracy(acc)
             logger.log("test", int(state.step), epoch=epoch, **result)
             targets = []
             if cfg.ckpt_dir and (
@@ -1336,6 +1474,7 @@ def run_officehome(
     logger = logger or MetricLogger()
     np.random.seed(cfg.seed)
     obs.maybe_enable(getattr(cfg, "obs_trace", None))
+    alert_engine = _setup_metrics_plane(cfg, logger)
     _apply_op_defaults(cfg)
     _maybe_init_distributed(cfg)
 
@@ -1456,6 +1595,8 @@ def run_officehome(
         # Callers guard on the log cadence BEFORE evaluating the metric
         # args (device slices); this helper only owns the record shape.
         logger.log("train", step_no, iter=it, cls_loss=cls, mec_loss=mec)
+        # Gauge feed AFTER logger.log materialized the scalars: no new sync.
+        _note_losses(cls_loss=cls, mec_loss=mec)
 
     def _boundary_actions(it):
         # Runs after the step at global index ``it``; with
@@ -1467,6 +1608,7 @@ def run_officehome(
                 result = evalp.evaluate(state, test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
             acc = result["accuracy"]
+            _note_accuracy(acc)
             logger.log("test", int(state.step), iter=it, **result)
             if cfg.ckpt_dir and acc > best_acc:
                 # The reference's "model_best_gr_N" convention: keep the
@@ -1533,6 +1675,7 @@ def run_officehome(
                 os.path.join(cfg.ckpt_dir, "watchdog") if cfg.ckpt_dir
                 else None
             ),
+            alerts=alert_engine,
         )
 
         def _proactive_save(st):
@@ -1779,6 +1922,7 @@ def run_officehome(
     with obs.span("eval_pass", imgs=len(test_ds)):
         result = evalp.evaluate(state, test_ds)
     acc = result["accuracy"]
+    _note_accuracy(acc)
     logger.log("final_test", int(state.step), **result)
     logger.log("params_digest", int(state.step), digest=_params_digest(state))
     if cfg.ckpt_dir:

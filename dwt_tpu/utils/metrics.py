@@ -176,6 +176,25 @@ class MetricLogger:
             self._file.close()
 
 
+def device_memory_stats() -> Optional[dict]:
+    """Device 0's allocator stats (bytes in use / limit / peak) where the
+    backend exposes them (TPU/GPU do; CPU returns None).  Never raises —
+    callers are /stats handlers and heartbeat records, which must answer
+    whatever the backend's mood.  Shared by the serving ``/stats`` path
+    and the training heartbeat (HBM growth must be visible during
+    training, not just serving)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
 def host_rss_mb() -> float:
     """Current resident set size in MB (``/proc/self/statm``; falls back
     to the peak-RSS rusage counter where /proc is unavailable)."""
@@ -209,6 +228,26 @@ class HeartbeatEmitter:
         self._last_step: Optional[int] = None
         self._last_t = 0.0
         self._rate: Optional[float] = None
+        # Live metrics plane: the heartbeat is the train loop's gauge
+        # feed (steps/s, host RSS, ckpt depth, device memory) — already
+        # host-side numbers, so feeding the registry adds no syncs.
+        from dwt_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._g_rate = reg.gauge(
+            "dwt_train_steps_per_s", "train steps/s EWMA (heartbeat)"
+        )
+        self._g_rss = reg.gauge(
+            "dwt_host_rss_mb", "host resident set size (MB)"
+        )
+        self._g_ckpt = reg.gauge(
+            "dwt_ckpt_in_flight", "async checkpoint saves in flight"
+        )
+        self._g_devmem = reg.gauge(
+            "dwt_device_memory_bytes",
+            "device 0 allocator stats where the backend reports them",
+            labelnames=("stat",),
+        )
 
     def step(self, gstep: int) -> None:
         if self.every <= 0:
@@ -226,12 +265,29 @@ class HeartbeatEmitter:
             0.7 * self._rate + 0.3 * rate
         )
         self._last_step, self._last_t = gstep, now
+        rss = host_rss_mb()
         values = {
             "steps_per_s": round(self._rate, 3),
-            "rss_mb": round(host_rss_mb(), 1),
+            "rss_mb": round(rss, 1),
         }
+        self._g_rate.set(self._rate)
+        self._g_rss.set(rss)
         if self._in_flight is not None:
-            values["ckpt_in_flight"] = int(self._in_flight())
+            depth = int(self._in_flight())
+            values["ckpt_in_flight"] = depth
+            self._g_ckpt.set(depth)
+        # Device memory (TPU/GPU allocator stats; absent on CPU): HBM
+        # growth during TRAINING becomes visible in both the JSONL
+        # heartbeat and the scrape — until now only the serving /stats
+        # path reported it.
+        mem = device_memory_stats()
+        if mem:
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in mem:
+                    values[f"device_{key}"] = mem[key]
+            for key, v in mem.items():
+                self._g_devmem.labels(stat=key).set(v)
         # flush (no fsync): the heartbeat is the liveness signal an
         # operator greps DURING a hang — buffered, the newest one would
         # sit in userspace through exactly that window (no later log()

@@ -818,6 +818,76 @@ def test_fleet_cli_sigkill_ejection_keeps_serving(tmp_path):
 
 
 @pytest.mark.slow
+def test_fleet_cli_respawn_restores_sigkilled_replica():
+    """--respawn_max: a SIGKILLed replica is re-spawned (fresh process,
+    fresh port) and re-admitted — the fleet recovers to full strength
+    instead of shrinking (ISSUE-12 satellite; closes the ROADMAP fleet
+    respawn item)."""
+    import urllib.request
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.fleet.balancer",
+         "--replicas", "2", "--port", "0",
+         "--health_interval_s", "0.3",
+         "--respawn_max", "2", "--respawn_backoff_s", "0.2", "--",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "fleet_ready"
+        port = ready["port"]
+        victim_pid = ready["replicas"][0]["pid"]
+
+        def health():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                return json.loads(resp.read())
+
+        assert health()["healthy_replicas"] == 2
+        os.kill(victim_pid, signal.SIGKILL)
+        # The probe ejects, the respawner spawns a fresh replica (which
+        # must re-compile its buckets), the next probe re-admits it.
+        deadline = time.monotonic() + 120
+        h = {}
+        while time.monotonic() < deadline:
+            h = health()
+            victim = next(r for r in h["replicas"] if r["rid"] == 0)
+            if h["healthy_replicas"] == 2 and victim.get("respawns"):
+                break
+            time.sleep(0.5)
+        assert h["healthy_replicas"] == 2, h
+        victim = next(r for r in h["replicas"] if r["rid"] == 0)
+        assert victim["respawns"] == 1 and victim["pid"] != victim_pid
+        # The respawned replica actually serves through the balancer.
+        body = json.dumps(
+            {"inputs": np.zeros((1, 28, 28, 1)).tolist()}
+        ).encode()
+        for _ in range(4):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/infer", data=body,
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.status == 200
+        # The respawn is visible on the aggregated metrics surface.
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert 'dwt_fleet_respawns_total{rid="0"} 1' in metrics
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
 def test_sustained_load_swap_p99_within_2x_steady(tmp_path, fleet_setup):
     """Acceptance: under sustained open-loop load, hot swaps complete
     with zero shed/failed requests and the swap-window p99 stays within

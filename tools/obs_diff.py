@@ -1,0 +1,402 @@
+"""Cross-run regression gate: two runs in, a verdict out.
+
+The repo accumulates run artifacts — ``bench.py`` JSON records
+(``BENCH_*.json``), ``tools/obs_report.py --json`` summaries,
+``tools/serve_bench.py`` JSONL — but until now turning two of them into
+"did we regress?" was an eyeball job.  This tool is the CI-able gate:
+
+    python tools/obs_diff.py BENCH_r05.json bench_now.json
+    python tools/obs_diff.py report_base.json report_now.json --tolerance 10
+    python tools/obs_diff.py serve_base.jsonl serve_now.jsonl \
+        --tol 'serve@800.e2e_ms_p99=25'
+    bench.py --compare BENCH_r05.json      # same gate, one command
+
+Input formats are auto-detected per record (a file may be one JSON
+object, concatenated objects, or JSONL; every record found is merged):
+
+* **bench.py record** (``"metric"``/``"value"`` keys, or the round
+  driver's ``{"parsed": {...}}`` wrapper) → the named throughput metric,
+  ``step_time_ms``, ``mfu``;
+* **obs_report --json** (``"kind": "obs_report"``) → per-process loop
+  ms/step plus each phase's self-time ms/step, serving per-bucket p99s;
+* **serve_bench JSONL** (``"kind": "serve_bench"``) → per-offered-load
+  achieved rate, latency percentiles, shed rate.
+
+Every extracted metric has a DIRECTION (higher-better: throughput,
+accuracy, MFU; lower-better: times, percentiles, shed/error rates) and a
+tolerance band (default ``--tolerance`` %, per-metric ``--tol name=pct``
+overrides).  A metric worse than the band is a REGRESSION; better than
+the band is reported as improved; inside the band is ok.  A baseline
+metric absent from the current run is MISSING (a silently-dropped
+measurement must not read as a pass); current-only metrics are
+informational.
+
+Exit codes: 0 = ok (an identical-run self-diff always passes),
+2 = unusable input, 3 = regression, 4 = missing metrics (with
+``--missing fail``, the default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Direction classification: first match wins, name-anchored patterns
+# before generic suffixes.  "up" = higher is better.
+_DIRECTION_RULES: List[Tuple[str, str]] = [
+    (r"(imgs_per_s|imgs_per_sec|steps_per_s|per_sec)", "up"),
+    (r"(accuracy|mfu)$", "up"),
+    (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
+    (r"(_ms|_s)(_p[0-9.]+)?$", "down"),
+    (r"(ms_per_step|step_time|stall|latency|duration)", "down"),
+]
+
+
+def direction_of(name: str,
+                 overrides: Optional[Dict[str, str]] = None
+                 ) -> Optional[str]:
+    """"up" / "down" / None (unknown: reported, never gated)."""
+    if overrides and name in overrides:
+        return overrides[name]
+    for pattern, d in _DIRECTION_RULES:
+        if re.search(pattern, name):
+            return d
+    return None
+
+
+# --------------------------------------------------------------- loading
+
+
+def _decode_records(text: str, path: str) -> List[dict]:
+    """One JSON object, concatenated objects, or JSONL -> [records]."""
+    text = text.strip()
+    if not text:
+        raise ValueError(f"{path}: empty file")
+    decoder = json.JSONDecoder()
+    records: List[dict] = []
+    idx = 0
+    while idx < len(text):
+        while idx < len(text) and text[idx] in " \t\r\n":
+            idx += 1
+        if idx >= len(text):
+            break
+        try:
+            obj, end = decoder.raw_decode(text, idx)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not valid JSON at offset {idx}: {e}")
+        if not isinstance(obj, dict):
+            raise ValueError(f"{path}: expected JSON objects, got "
+                             f"{type(obj).__name__}")
+        records.append(obj)
+        idx = end
+    return records
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _extract_bench(rec: dict, out: Dict[str, float]) -> None:
+    value = _num(rec.get("value"))
+    if value is not None:
+        out[str(rec["metric"])] = value
+    for key in ("step_time_ms", "mfu", "step_time_ms_percall"):
+        v = _num(rec.get(key))
+        if v is not None:
+            out[key] = v
+
+
+def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
+    offered = rec.get("offered_imgs_per_s", "?")
+    prefix = f"serve@{offered:g}" if isinstance(
+        offered, (int, float)) else f"serve@{offered}"
+    for key in ("achieved_imgs_per_s", "shed_rate",
+                "e2e_ms_p50", "e2e_ms_p95", "e2e_ms_p99",
+                "queue_ms_p50", "queue_ms_p99",
+                "device_ms_p50", "device_ms_p99",
+                "swap_e2e_ms_p99", "steady_e2e_ms_p99"):
+        v = _num(rec.get(key))
+        if v is not None:
+            out[f"{prefix}.{key}"] = v
+
+
+def _extract_obs_report(rec: dict, out: Dict[str, float]) -> None:
+    for pid, proc in (rec.get("processes") or {}).items():
+        train = proc.get("train")
+        if train:
+            steps = max(int(train.get("n_steps") or 0), 1)
+            wall = _num(train.get("wall_s"))
+            if wall is not None:
+                out[f"p{pid}.train_ms_per_step"] = 1e3 * wall / steps
+            for phase, p in (train.get("phases") or {}).items():
+                self_s = _num(p.get("self_s"))
+                if self_s is not None:
+                    out[f"p{pid}.{phase}_ms_per_step"] = (
+                        1e3 * self_s / steps
+                    )
+            ua = _num(train.get("unattributed_s"))
+            if ua is not None:
+                out[f"p{pid}.unattributed_ms_per_step"] = 1e3 * ua / steps
+        serve = proc.get("serve")
+        if serve:
+            for bucket, phases in (serve.get("buckets") or {}).items():
+                for phase, s in phases.items():
+                    p99 = _num(s.get("ms_p99"))
+                    if p99 is not None:
+                        out[f"p{pid}.serve.b{bucket}.{phase}_ms_p99"] = p99
+
+
+def extract_metrics(records: List[dict]) -> Dict[str, float]:
+    """Flatten every recognized record into one {metric: value} dict.
+    Later records win name collisions (a sweep's records carry distinct
+    prefixes, so collisions mean a re-measurement of the same thing)."""
+    out: Dict[str, float] = {}
+    for rec in records:
+        if isinstance(rec.get("parsed"), dict):  # round-driver wrapper
+            rec = rec["parsed"]
+        kind = rec.get("kind")
+        if "metric" in rec and "value" in rec:
+            _extract_bench(rec, out)
+        elif kind == "serve_bench":
+            _extract_serve_bench(rec, out)
+        elif kind == "obs_report":
+            _extract_obs_report(rec, out)
+        # Unrecognized records (heartbeats, access lines riding a mixed
+        # JSONL) are skipped: the gate compares measurements, not logs.
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    with open(path) as f:
+        text = f.read()
+    metrics = extract_metrics(_decode_records(text, path))
+    if not metrics:
+        raise ValueError(
+            f"{path}: no recognizable metrics (expected a bench.py "
+            "record, an obs_report --json summary, or serve_bench JSONL)"
+        )
+    return metrics
+
+
+# --------------------------------------------------------------- diffing
+
+OK = "ok"
+IMPROVED = "improved"
+REGRESSED = "REGRESSED"
+MISSING = "MISSING"
+NEW = "new"
+INFO = "n/a"
+
+
+def diff_metrics(
+    baseline: Dict[str, float],
+    current: Dict[str, float],
+    default_tolerance_pct: float = 5.0,
+    tolerances: Optional[Dict[str, float]] = None,
+    directions: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """Per-metric comparison rows (baseline order, then current-only)."""
+    rows: List[dict] = []
+    for name, base in baseline.items():
+        tol = (tolerances or {}).get(name, default_tolerance_pct)
+        d = direction_of(name, directions)
+        row = {
+            "metric": name, "baseline": base, "tolerance_pct": tol,
+            "direction": d,
+        }
+        if name not in current:
+            row.update(verdict=MISSING, current=None, delta_pct=None)
+            rows.append(row)
+            continue
+        cur = current[name]
+        row["current"] = cur
+        if base == 0:
+            delta_pct = 0.0 if cur == 0 else float("inf") * (
+                1 if cur > 0 else -1
+            )
+        else:
+            delta_pct = 100.0 * (cur - base) / abs(base)
+        row["delta_pct"] = delta_pct
+        if d is None:
+            row["verdict"] = INFO
+        elif d == "up":
+            row["verdict"] = (
+                REGRESSED if delta_pct < -tol
+                else IMPROVED if delta_pct > tol else OK
+            )
+        else:
+            row["verdict"] = (
+                REGRESSED if delta_pct > tol
+                else IMPROVED if delta_pct < -tol else OK
+            )
+        rows.append(row)
+    for name, cur in current.items():
+        if name not in baseline:
+            rows.append({
+                "metric": name, "baseline": None, "current": cur,
+                "delta_pct": None, "tolerance_pct": None,
+                "direction": direction_of(name, directions),
+                "verdict": NEW,
+            })
+    return rows
+
+
+def _fmt(v, digits=4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "nan"
+        if abs(v) >= 1000:
+            return f"{v:.1f}"
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def markdown_table(rows: List[dict]) -> str:
+    header = ("| metric | baseline | current | delta | band | verdict |\n"
+              "|---|---|---|---|---|---|")
+    lines = [header]
+    for r in rows:
+        delta = (
+            "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        )
+        band = (
+            "-" if r["tolerance_pct"] is None else
+            f"±{r['tolerance_pct']:g}%"
+            + ({"up": "↑", "down": "↓"}.get(r["direction"]) or "")
+        )
+        lines.append(
+            f"| {r['metric']} | {_fmt(r['baseline'])} | "
+            f"{_fmt(r.get('current'))} | {delta} | {band} | "
+            f"{r['verdict']} |"
+        )
+    return "\n".join(lines)
+
+
+def verdict_rc(rows: List[dict], missing: str = "fail") -> int:
+    """0 ok; 3 regression; 4 missing metric (when missing='fail').
+    Regression outranks missing — it is the louder fact."""
+    if any(r["verdict"] == REGRESSED for r in rows):
+        return 3
+    if missing == "fail" and any(r["verdict"] == MISSING for r in rows):
+        return 4
+    return 0
+
+
+def gate(baseline_path: str, current, *,
+         default_tolerance_pct: float = 5.0,
+         tolerances: Optional[Dict[str, float]] = None,
+         directions: Optional[Dict[str, str]] = None,
+         missing: str = "fail",
+         out=sys.stdout) -> int:
+    """One-call form for embedding (``bench.py --compare``): ``current``
+    is a path OR an already-built record dict.  Prints the markdown
+    table; returns the gate's exit code."""
+    base = load_metrics(baseline_path)
+    if isinstance(current, dict):
+        cur = extract_metrics([current])
+    else:
+        cur = load_metrics(current)
+    rows = diff_metrics(
+        base, cur, default_tolerance_pct, tolerances, directions
+    )
+    print(markdown_table(rows), file=out)
+    rc = verdict_rc(rows, missing)
+    summary = {
+        "kind": "obs_diff",
+        "baseline": baseline_path,
+        "metrics": len(rows),
+        "regressed": sum(r["verdict"] == REGRESSED for r in rows),
+        "missing": sum(r["verdict"] == MISSING for r in rows),
+        "improved": sum(r["verdict"] == IMPROVED for r in rows),
+        "rc": rc,
+    }
+    print(json.dumps(summary), file=out)
+    return rc
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _parse_kv(pairs: List[str], what: str, cast) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"obs_diff: bad {what} {pair!r} "
+                             "(expected name=value)")
+        name, _, value = pair.partition("=")
+        try:
+            out[name] = cast(value)
+        except ValueError:
+            raise SystemExit(f"obs_diff: bad {what} value {value!r}")
+    return out
+
+
+def _cast_direction(v: str) -> str:
+    if v not in ("up", "down"):
+        raise ValueError(v)
+    return v
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cross-run regression gate over bench/report/"
+        "serve-bench artifacts (exit 0 ok / 3 regression / 4 missing)"
+    )
+    ap.add_argument("baseline", help="baseline run artifact (JSON/JSONL)")
+    ap.add_argument("current", help="current run artifact (JSON/JSONL)")
+    ap.add_argument("--tolerance", type=float, default=5.0,
+                    help="default per-metric tolerance band in percent "
+                         "(default 5)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=PCT",
+                    help="per-metric tolerance override (repeatable)")
+    ap.add_argument("--direction", action="append", default=[],
+                    metavar="METRIC=up|down",
+                    help="direction override for metrics the built-in "
+                         "rules misclassify or do not know (repeatable)")
+    ap.add_argument("--missing", choices=["fail", "ignore"],
+                    default="fail",
+                    help="baseline metrics absent from the current run: "
+                         "fail (exit 4, default) or ignore")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the full row list as JSON here")
+    args = ap.parse_args(argv)
+
+    tolerances = {
+        k: float(v) for k, v in _parse_kv(args.tol, "--tol", float).items()
+    }
+    directions = {
+        k: str(v) for k, v in _parse_kv(
+            args.direction, "--direction", _cast_direction
+        ).items()
+    }
+    try:
+        base = load_metrics(args.baseline)
+        cur = load_metrics(args.current)
+    except (OSError, ValueError) as e:
+        print(f"obs_diff: {e}", file=sys.stderr)
+        return 2
+    rows = diff_metrics(base, cur, args.tolerance, tolerances, directions)
+    print(markdown_table(rows))
+    rc = verdict_rc(rows, args.missing)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"kind": "obs_diff", "rc": rc, "rows": rows}, f,
+                      indent=2)
+    print(json.dumps({
+        "kind": "obs_diff", "rc": rc,
+        "regressed": sum(r["verdict"] == REGRESSED for r in rows),
+        "missing": sum(r["verdict"] == MISSING for r in rows),
+    }))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
