@@ -1,0 +1,37 @@
+"""Content-addressed incremental checkpoint store (ISSUE-13).
+
+``store.py`` holds the whole subsystem: the shared blob store (leaf
+bytes keyed by digest), delta manifests chaining to a parent full save,
+chain validation, refcounted blob GC, and the streaming restore that
+reads each leaf straight from its blob onto its target sharding.
+"""
+
+from dwt_tpu.ckpt.store import (
+    BLOBS_DIR,
+    GC_MIN_AGE_S,
+    blob_store_root,
+    cas_invalid_reason,
+    gc_blobs,
+    promote_delta,
+    resolve_leaves,
+    restore_cas_state,
+    restore_cas_tree,
+    save_delta,
+    stage_delta,
+    tree_bytes,
+)
+
+__all__ = [
+    "BLOBS_DIR",
+    "GC_MIN_AGE_S",
+    "blob_store_root",
+    "cas_invalid_reason",
+    "gc_blobs",
+    "promote_delta",
+    "resolve_leaves",
+    "restore_cas_state",
+    "restore_cas_tree",
+    "save_delta",
+    "stage_delta",
+    "tree_bytes",
+]

@@ -1,0 +1,791 @@
+"""Content-addressed delta checkpoint store (ISSUE-13).
+
+The whole-tree formats (`utils/checkpoint.py`: Orbax and host-shard)
+rewrite every byte of the state at every save.  The flagship fine-tune
+profile wastes nearly all of those bytes: a frozen/near-frozen backbone
+is bitwise-stable between saves (zero grads keep its Adam moments stable
+too), BN/whitening running stats drift slowly, and only the from-scratch
+head really churns.  This store writes, per save, only the leaves whose
+content moved:
+
+* **blob store** — ``<store>/blobs/<d[:2]>/<digest>.bin``: raw C-order
+  leaf bytes keyed by a SHA-256 over (dtype, shape, bytes).  Writes are
+  tmp+fsync+rename (atomic, idempotent); a blob that already exists is
+  reused and only its mtime is bumped (the GC age guard, below).
+* **manifests** — each step dir holds one ``manifest.json`` with
+  ``format: cas_delta``.  A **full** manifest lists every leaf (path,
+  dtype, shape, digest, nbytes) and has no parent.  A **delta** manifest
+  lists ONLY the leaves whose digest moved since ``parent_step`` and
+  chains to it; unchanged leaves resolve through the parent chain.
+  ``delta_max_chain`` caps the chain length — past it the next save is
+  forced full, so a restore reads a bounded number of manifests and a
+  torn chain has a bounded blast radius.
+* **atomic finalize** — the manifest stages under ``.tmp-cas-<step>/``
+  and is renamed into place only after the chain validates (same
+  rename-as-finalize contract as every other format: an unpromoted save
+  is invisible to ``valid_steps``).
+* **validation** — a candidate is valid only if its whole chain resolves
+  (every parent manifest readable, leaf count complete) and every
+  referenced blob exists at its recorded size.  A missing/torn parent
+  blob therefore makes the candidate invalid and the ranked walk falls
+  back past it — never a mixed-generation restore.
+* **refcounted GC** — ``gc_blobs`` sweeps blobs referenced by NO
+  manifest under the store's root (main steps, anchors, best_* dirs,
+  and in-flight ``.tmp-*`` stages all count as references), guarded by a
+  minimum age so a save concurrently reusing a blob cannot lose it.
+  Pruning is chain-aware (``utils.checkpoint.prune_checkpoints``): a
+  step that is an ancestor of any kept manifest is never deleted.
+* **streaming restore** — each leaf is read straight from its blob onto
+  its target placement.  Under a sharded restore-to-spec target the blob
+  is memory-mapped and ``make_array_from_callback`` slices it per device
+  shard, so each process touches only the bytes its shards need — and
+  because blobs are whole global arrays (the save side gathers), a
+  checkpoint restores under ANY topology: different host count,
+  different ``--mesh_shape``, different plan (topology-elastic resume).
+
+Multi-host: the state handed to :func:`stage_delta` is process-
+replicated (``host_fetch`` + the plan's gather), so process 0 writes the
+blobs and manifest for everyone; the other ranks only run the finite
+gate so the save-done consensus stays consistent.  Promotion
+(:func:`promote_delta`) is process 0's filesystem rendezvous, exactly
+like the host-shard format's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dwt_tpu import obs
+from dwt_tpu.resilience import inject
+from dwt_tpu.utils.checkpoint import (
+    CAS_FORMAT,
+    MANIFEST,
+    _TMP_PREFIX,
+    _finalize_rename,
+    _np_dtype,
+    _read_manifest,
+    _root,
+    _sweep_stale_tmp,
+    _with_retries,
+    host_tree_all_finite,
+    is_valid_checkpoint,
+    keystr_to_path,
+    params_digest,
+    prune_checkpoints,
+)
+
+log = logging.getLogger(__name__)
+
+BLOBS_DIR = "blobs"
+_CAS_TMP = _TMP_PREFIX + "cas-"  # still .tmp-* : invisible to valid_steps
+DEFAULT_DELTA_MAX_CHAIN = 8
+
+# A blob younger than this is never GC'd even when unreferenced: it may
+# belong to a save whose manifest has not finalized yet, or have just had
+# its mtime bumped by a save that reused it (the reuse-vs-sweep race).
+# Same rationale and scale as checkpoint.STALE_TMP_AGE_S.
+GC_MIN_AGE_S = 3600.0
+
+# Hard ceiling on chain walks, far above any sane --delta_max_chain: a
+# corrupted parent_step cycle must terminate as "invalid", not spin.
+_CHAIN_HARD_CAP = 512
+
+# Blobs at least this large are memory-mapped on the sharded restore
+# path (each device shard slices only its own pages); smaller ones are
+# read whole — the mmap setup costs more than the read there.
+_MEMMAP_MIN_BYTES = 1 << 20
+
+
+def blob_store_root(ckpt_dir: str) -> str:
+    """The shared blob store for a run's checkpoint tree: main steps,
+    anchors, and best_* manifests under ``ckpt_dir`` all reference it."""
+    return os.path.join(_root(ckpt_dir), BLOBS_DIR)
+
+
+def tree_bytes(path: str) -> int:
+    """Total bytes of all files under ``path`` (the ``dwt_ckpt_dir_bytes``
+    gauge and the bench's on-disk accounting)."""
+    total = 0
+    for sub, _, names in os.walk(path):
+        for name in names:
+            try:
+                total += os.path.getsize(os.path.join(sub, name))
+            except OSError:
+                continue
+    return total
+
+
+def _leaf_digest(dtype: np.dtype, shape: Tuple[int, ...], raw: bytes) -> str:
+    h = hashlib.sha256()
+    h.update(str(dtype).encode())
+    h.update(repr(tuple(int(s) for s in shape)).encode())
+    h.update(raw)
+    return h.hexdigest()
+
+
+def _blob_path(store_root: str, digest: str) -> str:
+    return os.path.join(store_root, digest[:2], digest + ".bin")
+
+
+def _write_blob(store_root: str, digest: str, raw: bytes) -> int:
+    """Write one blob atomically; returns bytes written (0 when the blob
+    already exists — its mtime is bumped instead, so the GC age guard
+    covers the just-reused blob until the referencing manifest lands)."""
+    path = _blob_path(store_root, digest)
+    try:
+        if os.path.getsize(path) == len(raw):
+            os.utime(path)
+            return 0
+    except OSError:
+        pass
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(raw)
+
+
+def _count_delta_bytes(mode: str, nbytes: int) -> None:
+    from dwt_tpu.utils.checkpoint import count_ckpt_bytes
+
+    count_ckpt_bytes(mode, nbytes)
+
+
+# ------------------------------------------------------- chain resolution
+
+
+@dataclass
+class ResolvedChain:
+    """One candidate's fully resolved leaf table."""
+
+    manifest: dict                       # the newest (candidate) manifest
+    entries: Dict[str, Tuple[dict, str]]  # keystr path -> (entry, store)
+    chain_dirs: List[str]                # candidate-first manifest dirs
+
+
+def _chain_error(msg: str) -> ValueError:
+    return ValueError(msg)
+
+
+def resolve_leaves(step_dir: str, manifest: Optional[dict] = None) -> ResolvedChain:
+    """Resolve ``step_dir``'s full leaf table through its parent chain.
+
+    Walks candidate → parent → … → the base full manifest (all siblings
+    in the step dir's parent directory — ``.tmp-cas-*`` stages share that
+    parent, so a staged manifest resolves identically to a promoted one).
+    Raises :class:`ValueError` naming the first broken link: unreadable
+    parent manifest, mixed-format parent, cycle, over-long chain, or an
+    incomplete resolved leaf set.  Blob existence is NOT checked here —
+    that is :func:`cas_invalid_reason`'s second phase.
+    """
+    entries: Dict[str, Tuple[dict, str]] = {}
+    chain_dirs: List[str] = []
+    cur_dir = os.path.abspath(step_dir)
+    cur = manifest if manifest is not None else _read_manifest(cur_dir)
+    newest = cur
+    hops = 0
+    while True:
+        if cur is None:
+            raise _chain_error(
+                f"unreadable manifest at {cur_dir}"
+                + (" (torn/pruned parent of the chain)" if hops else "")
+            )
+        if cur.get("format") != CAS_FORMAT:
+            raise _chain_error(
+                f"{cur_dir} is not a {CAS_FORMAT} checkpoint — a delta "
+                "cannot chain onto a whole-tree-format parent"
+            )
+        store = os.path.normpath(
+            os.path.join(cur_dir, cur.get("blob_root", "../" + BLOBS_DIR))
+        )
+        for entry in cur.get("leaves", []):
+            entries.setdefault(entry["path"], (entry, store))
+        chain_dirs.append(cur_dir)
+        parent = cur.get("parent_step")
+        if cur.get("mode") == "full":
+            break
+        if parent is None:
+            raise _chain_error(
+                f"delta manifest at {cur_dir} has no parent_step"
+            )
+        if int(parent) >= int(cur.get("step", -1)):
+            raise _chain_error(
+                f"manifest at {cur_dir} chains to parent step {parent} "
+                ">= its own step (cycle)"
+            )
+        hops += 1
+        if hops > _CHAIN_HARD_CAP:
+            raise _chain_error(
+                f"delta chain under {step_dir} exceeds {_CHAIN_HARD_CAP} "
+                "links"
+            )
+        cur_dir = os.path.join(os.path.dirname(cur_dir), str(int(parent)))
+        cur = _read_manifest(cur_dir)
+    want = newest.get("leaf_count")
+    if want is not None and len(entries) != int(want):
+        raise _chain_error(
+            f"chain under {step_dir} resolves {len(entries)} leaves; the "
+            f"manifest expects {want} (incomplete/mismatched chain)"
+        )
+    return ResolvedChain(manifest=newest, entries=entries,
+                         chain_dirs=chain_dirs)
+
+
+def cas_invalid_reason(step_dir: str,
+                       manifest: Optional[dict] = None) -> Optional[str]:
+    """None when ``step_dir`` is a fully restorable cas checkpoint, else
+    a one-line reason (the ranked walk's per-candidate skip message):
+    chain resolution first, then every referenced blob's existence and
+    recorded size — a missing or truncated parent blob invalidates the
+    candidate and the walk falls back past it."""
+    try:
+        resolved = resolve_leaves(step_dir, manifest)
+    except ValueError as e:
+        return str(e)
+    return _blobs_invalid_reason(resolved)
+
+
+def _blobs_invalid_reason(resolved: ResolvedChain) -> Optional[str]:
+    for path, (entry, store) in resolved.entries.items():
+        blob = _blob_path(store, entry["digest"])
+        try:
+            size = os.path.getsize(blob)
+        except OSError:
+            return (
+                f"missing blob {entry['digest'][:12]}… for leaf {path} "
+                "(torn or swept parent blob)"
+            )
+        if size != int(entry["nbytes"]):
+            return (
+                f"truncated blob {entry['digest'][:12]}… for leaf {path} "
+                f"({size} bytes on disk, manifest says {entry['nbytes']})"
+            )
+    return None
+
+
+# ------------------------------------------------------------------ saving
+
+
+def _find_parent(root: str, step: int) -> Optional[ResolvedChain]:
+    """The newest valid cas step below ``step`` in ``root`` — the chain
+    parent a delta save diffs against.  A newest-previous step in a
+    whole-tree format (a run that switched ``--ckpt_format`` mid-flight)
+    yields None, forcing a full save; a torn cas candidate is walked
+    past, exactly like the restore walk would."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for s in sorted((int(d) for d in names if d.isdigit() and int(d) < step),
+                    reverse=True):
+        p = os.path.join(root, str(s))
+        manifest = _read_manifest(p)
+        if manifest is None:
+            continue
+        if manifest.get("format") != CAS_FORMAT:
+            return None  # previous save is a whole-tree artifact
+        try:
+            resolved = resolve_leaves(p, manifest)
+        except ValueError:
+            continue  # torn chain: look for an older chainable parent
+        if _blobs_invalid_reason(resolved) is not None:
+            continue  # torn blob: same fallback the restore walk takes
+        return resolved
+    return None
+
+
+def stage_delta(
+    ckpt_dir: str, step: int, host_state: Any, *,
+    store_root: Optional[str] = None,
+    delta_max_chain: int = DEFAULT_DELTA_MAX_CHAIN,
+    require_finite: bool = True,
+    write: bool = True,
+) -> Optional[dict]:
+    """Write ``host_state``'s moved blobs + a staged manifest under
+    ``.tmp-cas-<step>/``; returns the staged manifest, or None when
+    ``require_finite`` refuses the save.
+
+    Pure host I/O — safe on the checkpoint writer thread.  The per-leaf
+    digests computed for content addressing ARE the delta decision (the
+    manifest diff against the parent needs no byte comparison), and the
+    whole-params digest is recomputed from the same host bytes so the
+    manifest stays compatible with every existing digest consumer
+    (watcher dedup key, canary re-verification, restore validation).
+
+    ``write=False`` runs only the finite gate (multi-host non-primary
+    ranks: the state is process-replicated, so process 0 writes for
+    everyone, but every rank must reach the same refuse/accept verdict
+    for the save-done consensus to stay consistent).
+    """
+    if require_finite and not host_tree_all_finite(
+        getattr(host_state, "params", host_state)
+    ):
+        log.warning(
+            "skipping delta save @%d: non-finite params (a NaN checkpoint "
+            "would poison newest-valid resume)", step,
+        )
+        return None
+    if not write:
+        return {"step": int(step), "staged": False}
+    root = _root(ckpt_dir)
+    store = os.path.abspath(store_root) if store_root else os.path.join(
+        root, BLOBS_DIR
+    )
+    final = os.path.join(root, str(int(step)))
+    tmp = os.path.join(root, f"{_CAS_TMP}{int(step)}")
+
+    flat = jax.tree_util.tree_flatten_with_path(host_state)[0]
+    parent = _find_parent(root, int(step))
+    parent_entries = parent.entries if parent is not None else None
+    depth = (
+        int(parent.manifest.get("delta_depth", 0)) + 1
+        if parent is not None else 0
+    )
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    mode = "delta"
+    if parent is None:
+        mode = "full"
+    elif depth > max(0, int(delta_max_chain)):
+        # Chain cap: bound the manifests a restore reads.  A cap of 0
+        # (or below) means NO chaining — every save is full, the
+        # conservative all-whole-tree setting.
+        mode = "full"
+    elif set(paths) != set(parent_entries):
+        mode = "full"  # structure moved (different model/optimizer)
+
+    def _write():
+        inject.maybe_io_error(f"delta save @{step}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+        leaves, written = [], 0
+        for key, (_, leaf) in zip(paths, flat):
+            arr = np.asarray(leaf)
+            raw = arr.tobytes()  # C-order bytes for any layout
+            digest = _leaf_digest(arr.dtype, arr.shape, raw)
+            entry = {
+                "path": key,
+                "dtype": str(arr.dtype),
+                "shape": [int(s) for s in arr.shape],
+                "digest": digest,
+                "nbytes": len(raw),
+            }
+            if mode == "full":
+                written += _write_blob(store, digest, raw)
+                leaves.append(entry)
+                continue
+            prev = parent_entries.get(key)
+            if prev is not None and prev[0]["digest"] == digest:
+                continue  # unchanged: resolves through the parent chain
+            written += _write_blob(store, digest, raw)
+            leaves.append(entry)
+        manifest = {
+            "step": int(step),
+            "format": CAS_FORMAT,
+            "mode": mode,
+            "parent_step": (
+                int(parent.manifest["step"]) if mode == "delta" else None
+            ),
+            "delta_depth": depth if mode == "delta" else 0,
+            "blob_root": os.path.relpath(store, final),
+            "params_digest": params_digest(
+                getattr(host_state, "params", host_state)
+            ),
+            "timestamp": time.time(),
+            "leaf_count": len(flat),
+            "leaves": leaves,
+            "bytes_written": written,
+        }
+        mtmp = os.path.join(tmp, MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, os.path.join(tmp, MANIFEST))
+        _count_delta_bytes(
+            mode, written + os.path.getsize(os.path.join(tmp, MANIFEST))
+        )
+        return manifest
+
+    return _with_retries(_write, f"delta save @{step}")
+
+
+def _inherited_delta_blobs(resolved: ResolvedChain) -> List[str]:
+    """Blob paths the candidate inherits from DELTA ancestors (chain
+    links strictly between it and the base full save): deleting one
+    tears the chain back to the last full save without touching the
+    full save's own validity — the ``missing_parent_blob`` fault's
+    target set."""
+    if len(resolved.chain_dirs) < 3:
+        return []  # no delta ancestor between the candidate and the full
+    base = _read_manifest(resolved.chain_dirs[-1]) or {}
+    base_digests = {e["digest"] for e in base.get("leaves", [])}
+    own = {
+        e["digest"] for e in resolved.manifest.get("leaves", [])
+    }
+    out = []
+    for path, (entry, store) in resolved.entries.items():
+        d = entry["digest"]
+        if d in own or d in base_digests:
+            continue
+        out.append(_blob_path(store, d))
+    return sorted(out)
+
+
+def promote_delta(
+    ckpt_dir: str, step: int, keep: Optional[int] = None,
+    store_root: Optional[str] = None,
+) -> str:
+    """Finalize a staged delta save: validate the chain + every blob,
+    atomically rename ``.tmp-cas-<step>`` to ``<step>``, prune
+    (chain-aware) and GC unreferenced blobs.  Primary process only, pure
+    filesystem.  Idempotent when the step is already promoted (a
+    notice-driven save can coincide with the cadence save)."""
+    root = _root(ckpt_dir)
+    tmp = os.path.join(root, f"{_CAS_TMP}{int(step)}")
+    final = os.path.join(root, str(int(step)))
+    store = os.path.abspath(store_root) if store_root else os.path.join(
+        root, BLOBS_DIR
+    )
+    if not os.path.isdir(tmp) and is_valid_checkpoint(final):
+        return final
+    reason = cas_invalid_reason(tmp)
+    if reason is not None:
+        raise OSError(
+            f"cannot promote delta checkpoint step {step}: {reason} — the "
+            "previous finalized step stays authoritative"
+        )
+    # Fault hook: a SIGKILL landing here leaves only the staged tmp dir
+    # (blobs already durable, manifest unfinalized) — the walk must fall
+    # back to the previous finalized step on relaunch.
+    inject.maybe_kill_mid_delta_promote(step)
+    _finalize_rename(root, tmp, final, step)
+    _sweep_stale_tmp(root)
+    # GC only when pruning actually removed a manifest: blobs can only
+    # become unreferenced when a referencing manifest disappears, and an
+    # unconditional per-promote scan (every manifest parsed + the whole
+    # blob store listed) would grow with anchor count on exactly the
+    # path the fleet watcher waits on.  Crash-orphaned blobs (a stage
+    # that never promoted) get swept by the next pruning save.
+    if keep is not None and prune_checkpoints(root, keep) > 0:
+        gc_blobs(store)
+    plan = inject.current()
+    if plan is not None and plan.missing_parent_blob is not None:
+        # Fault hook: model an externally damaged store — a blob some
+        # DELTA ancestor wrote vanishes after this save finalizes, so
+        # the walk must skip the whole torn chain back to the full save.
+        inject.maybe_missing_parent_blob(
+            step, _inherited_delta_blobs(resolve_leaves(final))
+        )
+    return final
+
+
+def save_delta(
+    ckpt_dir: str, step: int, host_state: Any, *,
+    store_root: Optional[str] = None,
+    delta_max_chain: int = DEFAULT_DELTA_MAX_CHAIN,
+    keep: Optional[int] = None,
+    require_finite: bool = True,
+) -> Optional[str]:
+    """Stage + promote in one call — the synchronous/single-process save
+    path.  ``host_state`` is a host-side numpy pytree (``host_fetch``
+    output; pass the plan's gather there so sharded leaves arrive
+    process-replicated).  Returns the finalized path, or None when the
+    finite gate refused the save (no artifact — mirrors ``save_state``).
+
+    Multi-host: every process calls this (lockstep), process 0 does the
+    I/O, and all processes sync before returning — same contract as the
+    multi-host ``save_state``.
+    """
+    multihost = jax.process_count() > 1
+    if multihost:
+        from dwt_tpu.resilience.coord import assert_not_writer_thread
+
+        assert_not_writer_thread(f"multi-host delta checkpoint save @{step}")
+    primary = jax.process_index() == 0
+    staged = stage_delta(
+        ckpt_dir, step, host_state, store_root=store_root,
+        delta_max_chain=delta_max_chain, require_finite=require_finite,
+        write=primary,
+    )
+    path: Optional[str] = None
+    if staged is not None and primary:
+        path = promote_delta(ckpt_dir, step, keep=keep,
+                             store_root=store_root)
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"dwt_cas_save_{int(step)}")
+    if staged is None:
+        return None
+    return path if primary else os.path.join(_root(ckpt_dir), str(int(step)))
+
+
+# -------------------------------------------------------------------- GC
+
+
+def _iter_manifest_dirs(root: str):
+    """Every directory under ``root`` (depth <= 2) holding a manifest:
+    main steps, ``.tmp-*`` stages, and one-level subtrees (``anchors/``,
+    ``best_gr_*/``).  Bounded depth on purpose — the layout is fixed,
+    and a recursive walk over a large blob store would dominate GC."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return
+    for name in names:
+        if name == BLOBS_DIR:
+            continue
+        p = os.path.join(root, name)
+        if not os.path.isdir(p):
+            continue
+        if os.path.exists(os.path.join(p, MANIFEST)):
+            yield p
+            continue
+        try:
+            subnames = os.listdir(p)
+        except OSError:
+            continue
+        for sub in subnames:
+            q = os.path.join(p, sub)
+            if os.path.isdir(q) and os.path.exists(os.path.join(q, MANIFEST)):
+                yield q
+
+
+def gc_blobs(store_root: str,
+             min_age_s: float = GC_MIN_AGE_S) -> Tuple[int, int]:
+    """Sweep blobs referenced by no manifest under the store's parent
+    directory; returns ``(files_swept, bytes_swept)``.
+
+    The reference set is the union of every cas manifest's OWN leaf
+    entries — chain-aware pruning guarantees every kept manifest's
+    ancestors still exist, so their entries cover the inherited blobs,
+    and ``.tmp-*`` stages count so an in-flight save's fresh blobs are
+    never garbage.  ``min_age_s`` additionally protects young blobs
+    (a concurrent save may have just reused one without a finalized
+    manifest referencing it yet).
+    """
+    store = os.path.abspath(store_root)
+    root = os.path.dirname(store)
+    referenced = set()
+    for d in _iter_manifest_dirs(root):
+        manifest = _read_manifest(d)
+        if manifest is None or manifest.get("format") != CAS_FORMAT:
+            continue
+        for entry in manifest.get("leaves", []):
+            referenced.add(entry["digest"])
+    if not referenced:
+        # Fail safe: ZERO referencing manifests under the store's parent
+        # means either a fully-abandoned store (delete it by hand) or a
+        # store sited away from its manifests (a mis-passed store_root)
+        # — sweeping everything in the second case would invalidate
+        # every still-valid checkpoint, so refuse rather than guess.
+        log.warning(
+            "blob GC skipped: no cas manifests found under %s — if this "
+            "store is truly abandoned, remove it manually", root,
+        )
+        return 0, 0
+    swept = swept_bytes = 0
+    now = time.time()
+    try:
+        shards = os.listdir(store)
+    except OSError:
+        return 0, 0
+    for shard in shards:
+        sdir = os.path.join(store, shard)
+        if not os.path.isdir(sdir):
+            continue
+        for name in os.listdir(sdir):
+            digest = name[:-4] if name.endswith(".bin") else None
+            if digest is not None and digest in referenced:
+                continue
+            blob = os.path.join(sdir, name)
+            try:
+                st = os.stat(blob)
+                if now - st.st_mtime < min_age_s:
+                    continue
+                os.remove(blob)
+                swept += 1
+                swept_bytes += st.st_size
+            except OSError:
+                continue
+        try:
+            os.rmdir(sdir)  # drop empty fanout dirs; fails when non-empty
+        except OSError:
+            pass
+    if swept:
+        log.info(
+            "checkpoint blob GC: swept %d unreferenced blobs (%d bytes) "
+            "under %s", swept, swept_bytes, store,
+        )
+    return swept, swept_bytes
+
+
+# ----------------------------------------------------------------- restore
+
+
+def _read_blob_full(blob: str, dtype: np.dtype, shape, entry: dict,
+                    what: str) -> np.ndarray:
+    with open(blob, "rb") as f:
+        raw = f.read()
+    if len(raw) != int(entry["nbytes"]):
+        raise ValueError(
+            f"{what}: blob for {entry['path']} is {len(raw)} bytes; "
+            f"manifest says {entry['nbytes']}"
+        )
+    got = _leaf_digest(dtype, tuple(shape), raw)
+    if got != entry["digest"]:
+        raise ValueError(
+            f"{what}: leaf {entry['path']} failed blob digest validation "
+            f"({got[:12]}… != manifest {entry['digest'][:12]}…)"
+        )
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return np.frombuffer(raw, dtype=dtype, count=count).reshape(shape)
+
+
+def _open_blob_stream(blob: str, dtype: np.dtype, shape,
+                      entry: dict, what: str) -> np.ndarray:
+    """A read-only view of the blob for per-shard slicing.  Large blobs
+    memory-map (each device shard's ``make_array_from_callback`` slice
+    touches only its own pages — the 'read only the bytes the target
+    sharding needs' half of streaming restore); small ones read whole.
+    Size-validated; per-leaf digest verification is skipped on the mmap
+    path (it would force reading every byte, defeating the point) and
+    the caller logs that once, mirroring the sharded Orbax restore."""
+    try:
+        size = os.path.getsize(blob)
+    except OSError:
+        raise ValueError(
+            f"{what}: missing blob for leaf {entry['path']}"
+        ) from None
+    if size != int(entry["nbytes"]):
+        raise ValueError(
+            f"{what}: blob for {entry['path']} is {size} bytes; manifest "
+            f"says {entry['nbytes']}"
+        )
+    if size < _MEMMAP_MIN_BYTES or not shape:
+        return _read_blob_full(blob, dtype, shape, entry, what)
+    return np.memmap(blob, dtype=dtype, mode="r", shape=tuple(shape))
+
+
+def restore_cas_tree(path: str) -> Any:
+    """Loose (template-free) restore: the resolved chain rebuilt as a
+    nested dict of host numpy arrays — the serving path's read.  Every
+    leaf's blob digest is verified."""
+    resolved = resolve_leaves(path)
+    tree: dict = {}
+    for key, (entry, store) in resolved.entries.items():
+        dtype = _np_dtype(entry["dtype"])
+        arr = _read_blob_full(
+            _blob_path(store, entry["digest"]), dtype, entry["shape"],
+            entry, f"checkpoint {path}",
+        )
+        keys = keystr_to_path(key)
+        if not keys:
+            raise ValueError(f"checkpoint {path}: empty leaf path {key!r}")
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = arr
+    return tree
+
+
+def restore_cas_state(path: str, template: Any, shardings: Any = None) -> Any:
+    """Strict restore shaped like ``template``, streaming each leaf from
+    its blob onto its target placement.
+
+    ``shardings`` (restore-to-spec) or a non-fully-addressable template
+    leaf's own sharding routes through ``make_array_from_callback`` over
+    a memory-mapped blob: each device materializes only its own shard's
+    slice, no replicated intermediate, and each process reads only the
+    bytes its shards cover.  Otherwise leaves come back UNCOMMITTED
+    (``jnp.asarray`` — the multi-host DP resume contract), with the full
+    read verified against the per-leaf blob digest.
+
+    Because blobs hold whole (process-replicated) global arrays, the
+    same checkpoint restores under any topology: the saved host count
+    and mesh shape never constrain the target ones.
+    """
+    import jax.numpy as jnp
+
+    resolved = resolve_leaves(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if len(resolved.entries) != len(flat):
+        raise ValueError(
+            f"checkpoint {path} has {len(resolved.entries)} leaves; "
+            f"template expects {len(flat)} (structure mismatch)"
+        )
+    sharding_flat = (
+        jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "spec")
+        )
+        if shardings is not None else [None] * len(flat)
+    )
+    if len(sharding_flat) != len(flat):
+        raise ValueError(
+            f"checkpoint {path}: restore shardings have "
+            f"{len(sharding_flat)} leaves; template expects {len(flat)}"
+        )
+    what = f"checkpoint {path}"
+    leaves = []
+    streamed = 0
+    with obs.span("restore_place", "shard"):
+        for (tpath, tleaf), target in zip(flat, sharding_flat):
+            key = jax.tree_util.keystr(tpath)
+            hit = resolved.entries.get(key)
+            if hit is None:
+                raise ValueError(
+                    f"{what}: leaf {key} not in the resolved chain "
+                    "(template/model structure mismatch)"
+                )
+            entry, store = hit
+            shape = tuple(entry["shape"])
+            twant = tuple(getattr(tleaf, "shape", np.shape(tleaf)))
+            if shape != twant:
+                raise ValueError(
+                    f"{what}: {key} has shape {shape}; template expects "
+                    f"{twant}"
+                )
+            dtype = _np_dtype(entry["dtype"])
+            blob = _blob_path(store, entry["digest"])
+            if target is None and not getattr(
+                tleaf, "is_fully_addressable", True
+            ):
+                # Mid-training template (rollback): rebuild on the
+                # template's own global sharding, collective-free.
+                target = getattr(tleaf, "sharding", None)
+            if target is not None:
+                arr = _open_blob_stream(blob, dtype, shape, entry, what)
+                leaves.append(jax.make_array_from_callback(
+                    shape, target,
+                    lambda idx, a=arr: np.asarray(a[idx]),
+                ))
+                if isinstance(arr, np.memmap):
+                    streamed += 1
+                continue
+            # Startup resume: uncommitted, like fresh init (see the
+            # host-shard restore's place() for why pinning would break
+            # multi-host resume).  Full read -> per-leaf digest verify.
+            arr = _read_blob_full(blob, dtype, shape, entry, what)
+            leaves.append(jnp.asarray(arr))
+    if streamed:
+        log.info(
+            "streamed %d memory-mapped blobs onto target shardings for %s "
+            "(per-leaf digest verification skipped there: only each "
+            "shard's bytes were read; sizes validated)", streamed, path,
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -114,6 +114,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "snapshots + enqueues; digest/Orbax write/rename "
                         "run on a writer thread (--no-async_ckpt: every "
                         "save blocks the loop)")
+    p.add_argument("--ckpt_format", choices=["full", "delta"],
+                   default=d.ckpt_format,
+                   help="checkpoint on-disk format: 'full' writes the "
+                        "whole tree every save (byte-compatible default); "
+                        "'delta' is the content-addressed incremental "
+                        "store — only leaves whose digest moved are "
+                        "written, the frozen-backbone fine-tune's save "
+                        "bytes collapse to the churning head/stats")
+    p.add_argument("--delta_max_chain", type=int, default=d.delta_max_chain,
+                   help="delta-format chain cap: after this many chained "
+                        "delta saves the next save is forced full, "
+                        "bounding restore reads and torn-chain blast "
+                        "radius")
     p.add_argument("--anchor_every", type=int, default=d.anchor_every,
                    help=">0: every N iters also save an anchor checkpoint "
                         "under ckpt_dir/anchors, exempt from any pruning — "

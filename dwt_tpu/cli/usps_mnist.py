@@ -89,6 +89,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "snapshots + enqueues; digest/Orbax write/rename "
                         "run on a writer thread (--no-async_ckpt: every "
                         "save blocks the loop)")
+    p.add_argument("--ckpt_format", choices=["full", "delta"],
+                   default=d.ckpt_format,
+                   help="checkpoint on-disk format: 'full' writes the "
+                        "whole tree every save (existing Orbax/host-shard "
+                        "artifacts, byte-compatible default); 'delta' is "
+                        "the content-addressed incremental store — leaf "
+                        "blobs keyed by digest under <ckpt_dir>/blobs, "
+                        "manifests chaining to a parent full save, only "
+                        "moved leaves written per save, refcounted blob "
+                        "GC, topology-elastic streaming restore")
+    p.add_argument("--delta_max_chain", type=int, default=d.delta_max_chain,
+                   help="delta-format chain cap: after this many chained "
+                        "delta saves the next save is forced full, "
+                        "bounding the manifests a restore must read and "
+                        "the blast radius of a torn chain")
     p.add_argument("--anchor_every", type=int, default=d.anchor_every,
                    help=">0: every N epochs also save an anchor checkpoint "
                         "under ckpt_dir/anchors, exempt from any pruning — "

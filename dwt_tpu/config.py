@@ -78,6 +78,15 @@ class DigitsConfig:
     # hot path only snapshots + enqueues; digest/Orbax write/rename run on
     # a writer thread.  Off: every save blocks the loop (PR-1 behavior).
     async_ckpt: bool = True
+    # Checkpoint on-disk format (dwt_tpu/ckpt): "full" keeps the existing
+    # whole-tree artifacts byte-for-byte (default); "delta" routes saves
+    # through the content-addressed incremental store — blobs keyed by
+    # per-leaf digest in a shared <ckpt_dir>/blobs store, manifests
+    # chaining to a parent full save, only moved leaves written per save.
+    ckpt_format: str = "full"
+    # Max delta-chain length before a save is forced full: bounds the
+    # manifests a restore reads and the blast radius of a torn chain.
+    delta_max_chain: int = 8
     # >0: every N epochs also save an "anchor" checkpoint under
     # ckpt_dir/anchors, exempt from any pruning — bounds rollback distance
     # under repeated divergence.  0 = off.
@@ -193,6 +202,9 @@ class OfficeHomeConfig:
     keep_ckpts: int = 0
     # Background checkpoint pipeline — see DigitsConfig.async_ckpt.
     async_ckpt: bool = True
+    # Checkpoint format + delta-chain cap — see DigitsConfig.ckpt_format.
+    ckpt_format: str = "full"
+    delta_max_chain: int = 8
     # >0: every N iters also save an anchor checkpoint under
     # ckpt_dir/anchors (never pruned) — see DigitsConfig.anchor_every.
     anchor_every: int = 0

@@ -5,10 +5,15 @@ directory is either finalized-and-valid or invisible (``.tmp-*`` writes
 + atomic rename + manifest/size validation — ``utils.checkpoint``).  The
 watcher therefore needs no coordination with the writer at all: polling
 :func:`~dwt_tpu.utils.checkpoint.ranked_checkpoints` sees exactly the
-finalized steps, in both on-disk formats, with unpromoted host-shard
-steps and torn Orbax writes excluded by construction.  A candidate event
-is "the newest valid step changed": step + manifest params digest, which
-together are the version identity the whole fleet speaks
+finalized steps, in all three on-disk formats, with unpromoted
+host-shard/delta steps and torn Orbax writes excluded by construction —
+a ``cas_delta`` step (ISSUE-13) is a candidate only once its whole
+parent chain and every referenced blob validate, so the fleet can never
+deploy a delta the restore walk would refuse.  A candidate event is
+"the newest valid step changed": step + manifest params digest (the
+delta manifests record the same whole-params digest), which together
+are the version identity the whole fleet speaks — the dedup key is
+unchanged, and a delta save whose digest moved IS a new candidate
 (:class:`~dwt_tpu.serve.engine.Version`).
 """
 

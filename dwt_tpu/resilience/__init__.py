@@ -41,7 +41,9 @@ in CI on CPU:
 from dwt_tpu.resilience import inject
 from dwt_tpu.resilience.async_ckpt import (
     AsyncCheckpointer,
+    DeltaAsyncCheckpointer,
     MultiHostAsyncCheckpointer,
+    MultiHostDeltaAsyncCheckpointer,
     snapshot_state,
 )
 from dwt_tpu.resilience.coord import Coordinator, Decision
@@ -57,7 +59,9 @@ from dwt_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE, HangWatchdog
 
 __all__ = [
     "AsyncCheckpointer",
+    "DeltaAsyncCheckpointer",
     "MultiHostAsyncCheckpointer",
+    "MultiHostDeltaAsyncCheckpointer",
     "NoticeWatcher",
     "snapshot_state",
     "Coordinator",

@@ -126,18 +126,31 @@ class AsyncCheckpointer:
 
     # ------------------------------------------------------------- internals
 
-    def _run(self, targets, step: int, snapshot: Any) -> None:
+    def _prepare(self, snapshot: Any) -> Any:
+        """Writer-thread payload from the enqueued device snapshot —
+        identity here; the delta subclass host-fetches (single-process:
+        a device_get off the main thread is legal, and the writer
+        already dispatches device work via ``save_state``)."""
+        return snapshot
+
+    def _save_target(self, ckpt_dir: str, step: int, payload: Any,
+                     kwargs: dict):
+        """One target directory's save; format-specific in subclasses."""
         # Deferred import: utils.checkpoint imports resilience.inject, so a
         # module-level import here would be circular via the package init.
         from dwt_tpu.utils.checkpoint import save_state
 
+        return save_state(ckpt_dir, step, payload, **kwargs)
+
+    def _run(self, targets, step: int, snapshot: Any) -> None:
         try:
+            payload = self._prepare(snapshot)
             for ckpt_dir, kwargs in targets:
                 # Writer-thread span: the full background save (digest +
-                # Orbax write + rename) — what the hot path no longer
-                # pays, visible per save in the trace timeline.
+                # write + rename) — what the hot path no longer pays,
+                # visible per save in the trace timeline.
                 with obs.span("ckpt_write", "ckpt", step=int(step)):
-                    path = save_state(ckpt_dir, step, snapshot, **kwargs)
+                    path = self._save_target(ckpt_dir, step, payload, kwargs)
                 if path is not None:  # None = refused (non-finite), no artifact
                     self._last_path = path
         except BaseException as e:  # surfaced on the next enqueue/flush
@@ -271,15 +284,31 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
 
     # ------------------------------------------------------------- internals
 
-    def _run(self, targets, seq: int, step: int, host_tree) -> None:
+    def _write_target(self, ckpt_dir: str, step: int, host_tree,
+                      kwargs: dict) -> bool:
+        """This process's durable contribution to one target — False
+        when the finite gate refused (no artifact, no pending entry)."""
         from dwt_tpu.utils.checkpoint import save_host_shard
 
+        return save_host_shard(
+            ckpt_dir, step, host_tree, self.process_index,
+            require_finite=kwargs.get("require_finite", True),
+        )
+
+    def _promote(self, ckpt_dir: str, step: int, kwargs: dict) -> str:
+        """Process 0's finalization of one writer-completed target."""
+        from dwt_tpu.utils.checkpoint import promote_host_shards
+
+        return promote_host_shards(
+            ckpt_dir, step, self.process_count, keep=kwargs.get("keep"),
+        )
+
+    def _run(self, targets, seq: int, step: int, host_tree) -> None:
         try:
             for ckpt_dir, kwargs in targets:
                 with obs.span("shard_write", "ckpt", step=int(step)):
-                    wrote = save_host_shard(
-                        ckpt_dir, step, host_tree, self.process_index,
-                        require_finite=kwargs.get("require_finite", True),
+                    wrote = self._write_target(
+                        ckpt_dir, step, host_tree, kwargs
                     )
                 if wrote:
                     with self._pending_lock:
@@ -351,14 +380,9 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         for _seq, step, ckpt_dir, kwargs in due:
             if self.process_index != 0:
                 continue
-            from dwt_tpu.utils.checkpoint import promote_host_shards
-
             try:
                 with obs.span("ckpt_promote", "ckpt", step=int(step)):
-                    self._last_path = promote_host_shards(
-                        ckpt_dir, step, self.process_count,
-                        keep=kwargs.get("keep"),
-                    )
+                    self._last_path = self._promote(ckpt_dir, step, kwargs)
             except OSError as e:
                 if self._error is None:
                     self._error = e
@@ -373,3 +397,83 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         the loops' ``_CkptPipeline.flush`` owns that sequencing, since
         only the main loop may issue the collectives it needs."""
         return super().flush()
+
+
+class DeltaAsyncCheckpointer(AsyncCheckpointer):
+    """Single-process async writer for the content-addressed delta
+    format (``--ckpt_format delta``, ISSUE-13).
+
+    Same single-in-flight/backpressure/error contract; the writer
+    host-fetches the device snapshot (legal off the main thread on a
+    single process, exactly like the Orbax writer's own device work)
+    and hands it to the delta store — which reuses the per-leaf digests
+    it computes for content addressing as the manifest diff, so the
+    delta decision costs no extra hashing pass."""
+
+    def __init__(self, store_root=None,
+                 delta_max_chain: Optional[int] = None):
+        super().__init__()
+        self._store_root = store_root
+        self._delta_max_chain = delta_max_chain
+
+    def _prepare(self, snapshot: Any) -> Any:
+        from dwt_tpu.utils.checkpoint import host_fetch
+
+        return host_fetch(snapshot)
+
+    def _save_target(self, ckpt_dir: str, step: int, payload: Any,
+                     kwargs: dict):
+        from dwt_tpu.ckpt.store import DEFAULT_DELTA_MAX_CHAIN, save_delta
+
+        return save_delta(
+            ckpt_dir, step, payload,
+            store_root=self._store_root,
+            delta_max_chain=(
+                self._delta_max_chain
+                if self._delta_max_chain is not None
+                else DEFAULT_DELTA_MAX_CHAIN
+            ),
+            **kwargs,
+        )
+
+
+class MultiHostDeltaAsyncCheckpointer(MultiHostAsyncCheckpointer):
+    """Multi-host async writer for the delta format: identical snapshot
+    → main-thread host-fetch (+ plan gather) → writer-thread I/O →
+    consensus-driven promotion contract as the host-shard writer.  The
+    state arriving at the writer is process-replicated by construction,
+    so process 0 writes the blobs + staged manifest for everyone; the
+    other ranks run only the finite gate (their accept/refuse verdict
+    must match process 0's for the save-done consensus to stay
+    consistent, and the state being replicated guarantees it does)."""
+
+    def __init__(self, gather=None, store_root=None,
+                 delta_max_chain: Optional[int] = None):
+        super().__init__(gather=gather)
+        self._store_root = store_root
+        self._delta_max_chain = delta_max_chain
+
+    def _write_target(self, ckpt_dir: str, step: int, host_tree,
+                      kwargs: dict) -> bool:
+        from dwt_tpu.ckpt.store import DEFAULT_DELTA_MAX_CHAIN, stage_delta
+
+        staged = stage_delta(
+            ckpt_dir, step, host_tree,
+            store_root=self._store_root,
+            delta_max_chain=(
+                self._delta_max_chain
+                if self._delta_max_chain is not None
+                else DEFAULT_DELTA_MAX_CHAIN
+            ),
+            require_finite=kwargs.get("require_finite", True),
+            write=self.process_index == 0,
+        )
+        return staged is not None
+
+    def _promote(self, ckpt_dir: str, step: int, kwargs: dict) -> str:
+        from dwt_tpu.ckpt.store import promote_delta
+
+        return promote_delta(
+            ckpt_dir, step, keep=kwargs.get("keep"),
+            store_root=self._store_root,
+        )

@@ -36,6 +36,16 @@ production code at exactly the points the real fault would strike:
   between the leaf bytes and the shard manifest; SIGKILLs the process,
   i.e. a host dying mid-shard-write (promotion must refuse the torn
   shard; the previous finalized step stays authoritative).
+* ``maybe_kill_mid_delta_promote(step)`` — called by the delta store's
+  ``promote_delta`` after the chain validates but before the finalize
+  rename; SIGKILLs the process, i.e. dying mid-promote of a
+  content-addressed save (blobs durable, manifest staged-but-invisible
+  — relaunch must resume from the previous finalized step).
+* ``maybe_missing_parent_blob(step, paths)`` — called after the delta
+  save at ``step`` finalizes; deletes ONE blob a delta ancestor wrote
+  (never one of the base full save's own), modeling an externally
+  damaged store.  The newest-valid walk must skip the whole torn chain
+  back to the last full save — never a mixed-generation restore.
 * ``wrap_dataset(ds, role)`` — wraps a train dataset in
   :class:`FlakyDataset` when the plan condemns items for that role,
   driving the loader's retry/quarantine path from a subprocess.
@@ -129,11 +139,22 @@ class FaultPlan:
     # step.  Promotion must refuse the torn shard and the previous
     # finalized step stays authoritative.
     kill_writer_mid_shard: Any = None
+    # SIGKILL this process inside the delta store's promote, after the
+    # staged chain validates but before the finalize rename.  True =
+    # next promote; int = the save at that step.  The staged tmp dir
+    # stays invisible to the walk; relaunch resumes the previous step.
+    kill_mid_delta_promote: Any = None
+    # After the delta save at this step finalizes, delete one blob its
+    # chain inherits from a DELTA ancestor (the base full save's blobs
+    # are never touched) — the newest-valid walk must fall back past the
+    # torn chain to the last full save.
+    missing_parent_blob: Optional[int] = None
 
     _FIELDS = (
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
         "slow_step_s", "sigterm_at_step", "io_error_saves", "corrupt_items",
-        "notice_at_step", "kill_writer_mid_shard",
+        "notice_at_step", "kill_writer_mid_shard", "kill_mid_delta_promote",
+        "missing_parent_blob",
     )
 
     @classmethod
@@ -216,14 +237,19 @@ class FaultPlan:
                 f"{ENV_VAR}: crash_in_save must be true (next save) or an "
                 f"int step >= 1; got {crash!r}"
             )
-        kill_writer = spec.get("kill_writer_mid_shard")
-        if kill_writer is not None and kill_writer is not True and (
-                isinstance(kill_writer, bool)
-                or not isinstance(kill_writer, int) or kill_writer < 1):
-            raise ValueError(
-                f"{ENV_VAR}: kill_writer_mid_shard must be true (next "
-                f"shard write) or an int step >= 1; got {kill_writer!r}"
-            )
+        def _true_or_step(field):
+            v = spec.get(field)
+            if v is not None and v is not True and (
+                    isinstance(v, bool) or not isinstance(v, int) or v < 1):
+                raise ValueError(
+                    f"{ENV_VAR}: {field} must be true (next occurrence) "
+                    f"or an int step >= 1; got {v!r}"
+                )
+            return v
+
+        kill_writer = _true_or_step("kill_writer_mid_shard")
+        kill_promote = _true_or_step("kill_mid_delta_promote")
+        missing_blob = _opt_int("missing_parent_blob")
         corrupt = spec.get("corrupt_items")
         if corrupt is not None:
             if not isinstance(corrupt, dict):
@@ -256,6 +282,8 @@ class FaultPlan:
             corrupt_items=corrupt,
             notice_at_step=notice,
             kill_writer_mid_shard=kill_writer,
+            kill_mid_delta_promote=kill_promote,
+            missing_parent_blob=missing_blob,
         )
 
     @classmethod
@@ -428,6 +456,47 @@ def maybe_kill_writer_mid_shard(step: int) -> None:
     ):
         plan.kill_writer_mid_shard = None  # one-shot (if we survive…)
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_mid_delta_promote(step: int) -> None:
+    """SIGKILL the process if armed for this delta promote.  Called by
+    ``promote_delta`` after the staged chain validates but BEFORE the
+    finalize rename — the blobs are durable, the manifest is still in
+    the ``.tmp-cas-*`` stage, so the walk never sees the step and a
+    relaunch resumes from the previous finalized checkpoint."""
+    plan = current()
+    if plan is None or plan.kill_mid_delta_promote is None:
+        return
+    if plan.kill_mid_delta_promote is True or (
+        int(plan.kill_mid_delta_promote) == int(step)
+    ):
+        plan.kill_mid_delta_promote = None  # one-shot (if we survive…)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_missing_parent_blob(step: int, inherited_blobs: Any) -> None:
+    """Delete one chain-inherited blob if armed for this save's step —
+    an externally damaged store (buggy cleanup job, partial filesystem
+    loss) striking a blob the newest delta depends on but did not write.
+    ``inherited_blobs`` are paths written by DELTA ancestors only, so
+    the base full save stays restorable and the walk's fallback target
+    is well-defined.  Raises when the armed save has no such blobs
+    (a plan that cannot tear a chain proves nothing)."""
+    plan = current()
+    if plan is None or plan.missing_parent_blob is None:
+        return
+    if int(plan.missing_parent_blob) != int(step):
+        return
+    plan.missing_parent_blob = None  # one-shot
+    for path in inherited_blobs:
+        if os.path.exists(path):
+            os.remove(path)
+            return
+    raise ValueError(
+        f"{ENV_VAR}: missing_parent_blob armed at step {step}, but that "
+        "save inherits no delta-ancestor blobs (a full save or a "
+        "chain-base save) — the fault would be a silent no-op"
+    )
 
 
 def wrap_dataset(dataset: Any, role: str) -> Any:

@@ -9,6 +9,7 @@ from dwt_tpu.utils.metrics import (
 )
 from dwt_tpu.utils.checkpoint import (
     anchor_dir,
+    checkpoint_invalid_reason,
     is_valid_checkpoint,
     latest_step,
     ranked_checkpoints,
@@ -32,6 +33,7 @@ __all__ = [
     "percentile",
     "percentile_summary",
     "anchor_dir",
+    "checkpoint_invalid_reason",
     "is_valid_checkpoint",
     "latest_step",
     "ranked_checkpoints",

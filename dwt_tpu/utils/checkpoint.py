@@ -44,6 +44,16 @@ transparently.  The format requires the state to be process-replicated
 (this repo's DP design: params/opt-state/stats are identical on every
 host) — ``host_fetch`` refuses leaves whose local shard is narrower
 than the global shape.
+
+**Content-addressed delta format (ISSUE-13).**  A third on-disk format
+— ``--ckpt_format delta`` — lives in ``dwt_tpu/ckpt/store.py``: leaf
+blobs keyed by digest in a shared store, manifests chaining to a parent
+full save so each save writes only the leaves that moved.  This module
+stays the single walk/validity/restore authority: ``valid_steps``
+validates delta chains (and logs per-candidate skip reasons),
+``prune_checkpoints`` is chain-aware, and both restore paths dispatch on
+the manifest's ``format`` field, so every consumer (resume, rollback,
+watcher, serving) reads all three formats through the same functions.
 """
 
 from __future__ import annotations
@@ -68,6 +78,13 @@ log = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
 _TMP_PREFIX = ".tmp-"
+
+# Content-addressed delta format (ISSUE-13): manifests with this format
+# value chain to a parent manifest and reference leaf blobs in a shared
+# store — validation and restore live in ``dwt_tpu.ckpt.store`` (imported
+# lazily from the format branches below; the store imports THIS module at
+# module level, so the dependency edge stays one-way).
+CAS_FORMAT = "cas_delta"
 
 # Transient-I/O retry policy (checkpoint save/restore only; item-level
 # data retries live in dwt_tpu.data.loader).
@@ -130,44 +147,111 @@ def _write_manifest(
         json.dump(manifest, f, indent=1)
 
 
+# Parsed-manifest cache keyed by (mtime_ns, size): finalized manifests
+# are immutable (written once into a tmp sibling, renamed into place —
+# any rewrite lands a new mtime/size), so the cache can only go stale by
+# missing, never by serving old content.  Bounds the delta walk's cost
+# on poll paths: without it every watcher poll re-parses each
+# candidate's whole chain down to the (large) base full manifest.
+# Callers treat the returned dict as read-only (it is shared).
+_manifest_cache: dict = {}
+_MANIFEST_CACHE_CAP = 512
+
+
 def _read_manifest(path: str) -> Optional[dict]:
+    full = os.path.join(path, MANIFEST)
     try:
-        with open(os.path.join(path, MANIFEST)) as f:
-            return json.load(f)
+        st = os.stat(full)
+    except OSError:
+        return None
+    hit = _manifest_cache.get(full)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        return hit[2]
+    try:
+        with open(full) as f:
+            manifest = json.load(f)
     except (OSError, ValueError):
         return None
+    if len(_manifest_cache) >= _MANIFEST_CACHE_CAP:
+        _manifest_cache.clear()
+    _manifest_cache[full] = (st.st_mtime_ns, st.st_size, manifest)
+    return manifest
+
+
+def checkpoint_invalid_reason(path: str) -> Optional[str]:
+    """None when ``path`` is a valid finalized checkpoint, else a
+    one-line reason — the per-candidate skip message the ranked walk
+    logs, so an operator can tell a torn delta chain from a truncated
+    Orbax write without reproducing the walk by hand.
+
+    Unfinalized tmp dirs are never valid; manifest-less finalized dirs
+    are legacy artifacts and accepted as-is.  ``cas_delta`` manifests
+    validate their whole parent chain and every referenced blob
+    (``dwt_tpu.ckpt.store``) — a missing/torn parent blob or manifest
+    invalidates the candidate.
+    """
+    if not os.path.isdir(path):
+        return "not a directory"
+    if os.path.basename(path).startswith(_TMP_PREFIX):
+        return "unfinalized tmp directory"
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        return None  # legacy (pre-manifest) checkpoint
+    manifest = _read_manifest(path)
+    if manifest is None:
+        return "unreadable manifest"
+    if manifest.get("format") == CAS_FORMAT:
+        from dwt_tpu.ckpt.store import cas_invalid_reason
+
+        return cas_invalid_reason(path, manifest)
+    for rel, size in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            return f"manifest-listed file {rel} missing"
+        if os.path.getsize(full) != size:
+            return (
+                f"manifest-listed file {rel} truncated "
+                f"({os.path.getsize(full)} bytes, manifest says {size})"
+            )
+    return None
 
 
 def is_valid_checkpoint(path: str) -> bool:
-    """A finalized checkpoint whose manifest (if any) checks out.
+    """A finalized checkpoint whose manifest (if any) checks out."""
+    return checkpoint_invalid_reason(path) is None
 
-    Unfinalized tmp dirs are never valid; manifest-less finalized dirs are
-    legacy artifacts and accepted as-is.
-    """
-    if not os.path.isdir(path) or os.path.basename(path).startswith(_TMP_PREFIX):
-        return False
-    if not os.path.exists(os.path.join(path, MANIFEST)):
-        return True  # legacy (pre-manifest) checkpoint
-    manifest = _read_manifest(path)
-    if manifest is None:
-        return False
-    for rel, size in manifest.get("files", {}).items():
-        full = os.path.join(path, rel)
-        if not os.path.exists(full) or os.path.getsize(full) != size:
-            return False
-    return True
+
+# Last-logged skip reason per candidate path: the watcher polls the walk
+# every couple of seconds, so an invalid candidate must log once per
+# REASON, not once per poll.  Bounded (cleared past a cap) — test runs
+# churn tmp paths.
+_skip_logged: dict = {}
 
 
 def valid_steps(ckpt_dir: str) -> List[int]:
-    """Ascending step numbers of the valid checkpoints under ``ckpt_dir``."""
+    """Ascending step numbers of the valid checkpoints under ``ckpt_dir``.
+
+    Invalid candidates are skipped with their reason logged (once per
+    path+reason): the newest-valid walk silently falling past a torn
+    delta chain would hide exactly the evidence a post-mortem needs.
+    """
     root = _root(ckpt_dir)
     if not os.path.isdir(root):
         return []
-    return sorted(
-        int(d)
-        for d in os.listdir(root)
-        if d.isdigit() and is_valid_checkpoint(os.path.join(root, d))
-    )
+    out = []
+    for d in os.listdir(root):
+        if not d.isdigit():
+            continue
+        path = os.path.join(root, d)
+        reason = checkpoint_invalid_reason(path)
+        if reason is None:
+            out.append(int(d))
+            _skip_logged.pop(path, None)
+        elif _skip_logged.get(path) != reason:
+            if len(_skip_logged) > 512:
+                _skip_logged.clear()
+            _skip_logged[path] = reason
+            log.warning("skipping checkpoint candidate %s: %s", path, reason)
+    return sorted(out)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -196,6 +280,72 @@ def _sweep_stale_tmp(root: str, keep_name: Optional[str] = None) -> None:
         except OSError:
             continue
         shutil.rmtree(full, ignore_errors=True)
+
+
+def count_ckpt_bytes(mode: str, nbytes: int) -> None:
+    """Live-metrics feed: ``dwt_ckpt_bytes_written_total{mode=full|delta}``
+    — the scrapeable twin of ``tools/ckpt_bench.py``'s bytes accounting.
+    Whole-tree formats (Orbax, host-shard) count as ``full``; the cas
+    store labels each save by its manifest mode."""
+    from dwt_tpu.obs.registry import get_registry
+
+    get_registry().counter(
+        "dwt_ckpt_bytes_written_total",
+        "checkpoint bytes written to disk, by save mode",
+        labelnames=("mode",),
+    ).labels(mode=mode).inc(int(nbytes))
+
+
+def prune_checkpoints(root: str, keep: int) -> int:
+    """Prune ``root`` to its newest ``keep`` valid steps — chain-aware:
+    a step that is a chain ANCESTOR of any kept ``cas_delta`` manifest is
+    never deleted (deleting a kept delta's parent would tear exactly the
+    checkpoint the prune meant to keep).  Whole-tree-format steps have no
+    ancestors and prune as before.  Returns the number of step
+    directories removed (the delta store runs blob GC only when this is
+    nonzero — a prune that deleted nothing cannot have orphaned blobs).
+    """
+    steps = valid_steps(root)
+    if keep <= 0 or len(steps) <= keep:
+        return 0
+    kept = steps[-keep:]
+    protect = set(kept)
+
+    def _protect_ancestors(manifest):
+        hops = 0
+        while (
+            manifest is not None
+            and manifest.get("format") == CAS_FORMAT
+            and manifest.get("parent_step") is not None
+            and hops < 1024
+        ):
+            parent = int(manifest["parent_step"])
+            if parent in protect:
+                break
+            protect.add(parent)
+            manifest = _read_manifest(os.path.join(root, str(parent)))
+            hops += 1
+
+    for s in kept:
+        _protect_ancestors(_read_manifest(os.path.join(root, str(s))))
+    # In-flight ``.tmp-cas-*`` stages chain to FINALIZED parents too: a
+    # staged-but-unpromoted delta (multi-host: written, awaiting the
+    # save-done consensus) would be torn by pruning its parent out from
+    # under it — protect those chains exactly like the kept steps'.
+    try:
+        names = os.listdir(root)
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith(_TMP_PREFIX):
+            _protect_ancestors(_read_manifest(os.path.join(root, name)))
+    removed = 0
+    for old in steps[:-keep]:
+        if old in protect:
+            continue
+        shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+        removed += 1
+    return removed
 
 
 def _finalize_rename(root: str, tmp: str, final: str, step: int) -> None:
@@ -305,10 +455,12 @@ def save_state(
             shutil.rmtree(tmp, ignore_errors=True)
         raise
     if primary:
+        manifest = _read_manifest(final)
+        if manifest is not None:
+            count_ckpt_bytes("full", sum(manifest.get("files", {}).values()))
         _sweep_stale_tmp(root)
         if keep is not None:
-            for old in valid_steps(root)[:-keep]:
-                shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+            prune_checkpoints(root, keep)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -470,6 +622,7 @@ def save_host_shard(
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp_manifest, os.path.join(shard, SHARD_MANIFEST))
+        count_ckpt_bytes("full", offset)
 
     _with_retries(_write, f"host-shard save @{step}")
     return True
@@ -533,8 +686,7 @@ def promote_host_shards(
     _finalize_rename(root, tmp, final, step)
     _sweep_stale_tmp(root)
     if keep is not None:
-        for old in valid_steps(root)[:-keep]:
-            shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+        prune_checkpoints(root, keep)
     return final
 
 
@@ -657,6 +809,13 @@ def _restore_one(path: str, template: Any, shardings: Any = None) -> Any:
     manifest = _read_manifest(path)
     if manifest is not None and manifest.get("format") == HOST_SHARD_FORMAT:
         return _restore_host_shards(path, template, manifest, shardings)
+    if manifest is not None and manifest.get("format") == CAS_FORMAT:
+        # Content-addressed delta format: streaming per-leaf blob reads
+        # onto the target shardings (restore-to-spec) or uncommitted
+        # leaves — topology-elastic by construction (dwt_tpu.ckpt.store).
+        from dwt_tpu.ckpt.store import restore_cas_state
+
+        return restore_cas_state(path, template, shardings)
     if shardings is None:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     else:
@@ -944,6 +1103,10 @@ def restore_tree(path: str) -> Any:
     manifest = _read_manifest(path)
     if manifest is not None and manifest.get("format") == HOST_SHARD_FORMAT:
         restored = _restore_tree_host_shards(path)
+    elif manifest is not None and manifest.get("format") == CAS_FORMAT:
+        from dwt_tpu.ckpt.store import restore_cas_tree
+
+        restored = restore_cas_tree(path)
     else:
         def _read():
             with ocp.StandardCheckpointer() as ckptr:

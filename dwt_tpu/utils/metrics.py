@@ -234,6 +234,7 @@ class HeartbeatEmitter:
         from dwt_tpu.obs.registry import get_registry
 
         reg = get_registry()
+        self._reg = reg
         self._g_rate = reg.gauge(
             "dwt_train_steps_per_s", "train steps/s EWMA (heartbeat)"
         )
@@ -288,6 +289,17 @@ class HeartbeatEmitter:
                     values[f"device_{key}"] = mem[key]
             for key, v in mem.items():
                 self._g_devmem.labels(stat=key).set(v)
+        # Checkpoint-footprint feeds (ISSUE-13): cumulative bytes written
+        # by the save paths (by-mode counter summed) and the live on-disk
+        # size of --ckpt_dir (the _CkptPipeline's callback gauge — the
+        # read here invokes it, one directory walk per heartbeat).  Both
+        # absent when no checkpointing has happened in this process.
+        written = self._reg.samples("dwt_ckpt_bytes_written_total")
+        if written:
+            values["ckpt_bytes_written"] = int(sum(v for _, v in written))
+        dir_bytes = self._reg.value("dwt_ckpt_dir_bytes")
+        if dir_bytes:
+            values["ckpt_dir_bytes"] = int(dir_bytes)
         # flush (no fsync): the heartbeat is the liveness signal an
         # operator greps DURING a hang — buffered, the newest one would
         # sit in userspace through exactly that window (no later log()

@@ -92,6 +92,39 @@ def _assert_resumable(ck):
 # ----------------------------------------------------- tier-1 chaos smoke
 
 
+def test_chaos_kill_mid_delta_promote_falls_back(tmp_path):
+    """ISSUE-13 fault kind: SIGKILL inside the delta store's promote —
+    after the blobs and staged manifest are durable, before the finalize
+    rename.  The staged step must stay invisible (an incomplete chain is
+    never a restore candidate), and the relaunch resumes from the
+    previous finalized step — never a torn or mixed-generation restore.
+    Also the delta format's CLI E2E: both runs save through the async
+    delta writer."""
+    delta_args = ("--ckpt_format", "delta", "--epochs", "3")
+    # Saves land at steps 4, 8, 12; the kill hits the SECOND save (a
+    # delta chained on the step-4 full) mid-promote.
+    rc, ck, jsonl, _ = _run_digits(
+        tmp_path, {"kill_mid_delta_promote": 8}, extra=delta_args,
+    )
+    assert rc == -9  # SIGKILLed from inside the writer's promote
+    assert valid_steps(ck) == [4]
+    # The stage survived as an invisible .tmp-cas dir, blobs durable.
+    assert any(d.startswith(".tmp-cas-8") for d in os.listdir(ck))
+
+    rc, ck, jsonl, stderr = _run_digits(tmp_path, {}, extra=delta_args)
+    assert rc == 0, stderr[-2000:]
+    kinds = _kinds(jsonl)
+    assert "resume" in kinds
+    resume = [
+        json.loads(l) for l in open(jsonl).read().splitlines()
+        if json.loads(l)["kind"] == "resume"
+    ][0]
+    assert resume["step"] == 4  # the previous finalized step, not the torn 8
+    assert _assert_resumable(ck) == 12  # completed: 3 epochs * 4 steps
+    with open(os.path.join(ck, "12", "manifest.json")) as f:
+        assert json.load(f)["format"] == "cas_delta"
+
+
 def test_chaos_smoke_composed_faults_exit0_resumable(tmp_path):
     """Fast tier-1 case, four fault kinds composed in ONE plan: a slow
     step (the watchdog must tolerate a transient stall), one flaky save

@@ -25,6 +25,17 @@ Prints one JSON line:
 Acceptance gate for the ISSUE-2 pipeline: ``stall_reduction_x >= 5`` on
 CPU.  Run with ``JAX_PLATFORMS=cpu python tools/ckpt_bench.py``.
 
+``--delta`` (ISSUE-13) benches the content-addressed incremental store
+against full whole-tree saves on a **frozen-backbone churn profile**:
+between saves only the classifier head (``--churn`` regex, default
+``fc5|fc_out``) moves — the flagship fine-tune's save shape, where a
+frozen backbone's params AND Adam moments are bitwise-stable (zero
+grads keep the moments still).  Reports per-save bytes on disk and the
+synchronous save wall for both arms; the first delta save is the chain
+base (full) and is reported separately.  Acceptance gate: on
+tiny-resnet, a steady-state delta save writes <= 1/5 the bytes of a
+full save (measured: ~1/360 — the head is that small a slice).
+
 ``--processes 2`` (ISSUE-5) measures the MULTI-HOST arms on one machine:
 the parent respawns itself as N distributed ranks (loopback
 coordinator, the test harness's env-var convention) and rank 0 prints
@@ -151,6 +162,113 @@ def bench_async_multihost(state, bump, ckpt_dir: str, saves: int,
     return stalls, writer, state
 
 
+def make_frozen_bump(state, churn_regex: str):
+    """The frozen-backbone churn profile: one jitted step that perturbs
+    ONLY the leaves whose tree path matches ``churn_regex`` (params and
+    their mirrored optimizer moments both match — opt-state paths embed
+    the param names) plus the step counter.  Everything else stays
+    bitwise-stable, exactly like a frozen backbone under zero grads."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    churn = [
+        bool(re.search(churn_regex, jax.tree_util.keystr(p)))
+        and hasattr(leaf, "dtype")
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+        for p, leaf in flat
+    ]
+
+    @jax.jit
+    def bump(s):
+        leaves = jax.tree_util.tree_leaves(s)
+        out = [x * 0.999 if c else x for x, c in zip(leaves, churn)]
+        s = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(s), out
+        )
+        return s.replace(step=s.step + 1)
+
+    return bump, sum(churn)
+
+
+def bench_delta(state, bump, scratch: str, saves: int):
+    """Delta-vs-full byte/stall comparison under the frozen profile.
+
+    Both arms save the SAME state sequence synchronously; the full arm
+    is the existing whole-tree ``save_state`` (per-save bytes = the
+    finalized step dir's size), the delta arm is the cas store (per-save
+    bytes = the manifest's own accounting: blobs written + manifest).
+    """
+    import json as _json
+    import os as _os
+
+    import jax
+
+    from dwt_tpu.ckpt import save_delta, tree_bytes
+    from dwt_tpu.utils.checkpoint import host_fetch, save_state
+
+    full_dir = _os.path.join(scratch, "full")
+    delta_dir = _os.path.join(scratch, "delta")
+    full_ms, full_bytes, delta_ms, delta_bytes = [], [], [], []
+    for k in range(saves):
+        state = _advance(state, bump, 1)
+        t0 = time.perf_counter()
+        path = save_state(full_dir, int(k + 1), state)
+        full_ms.append((time.perf_counter() - t0) * 1e3)
+        full_bytes.append(tree_bytes(path))
+        t0 = time.perf_counter()
+        path = save_delta(delta_dir, int(k + 1), host_fetch(state))
+        delta_ms.append((time.perf_counter() - t0) * 1e3)
+        # Symmetric accounting: blobs written + the manifest file itself
+        # (the full arm's tree_bytes includes ITS manifest too).
+        mpath = _os.path.join(path, "manifest.json")
+        manifest = _json.load(open(mpath))
+        delta_bytes.append(
+            int(manifest["bytes_written"]) + _os.path.getsize(mpath)
+        )
+    return full_ms, full_bytes, delta_ms, delta_bytes
+
+
+def run_delta_bench(args) -> dict:
+    state, _ = build_state(args.model, args.batch)
+    bump, churned = make_frozen_bump(state, args.churn)
+    state = bump(state)  # compile outside the timed region
+    scratch = args.ckpt_dir or tempfile.mkdtemp(prefix="dwt_ckpt_delta_")
+    try:
+        from dwt_tpu.utils.checkpoint import save_state
+
+        save_state(os.path.join(scratch, "warmup"), 0, state)  # untimed
+        full_ms, full_bytes, delta_ms, delta_bytes = bench_delta(
+            state, bump, scratch, args.saves
+        )
+        # The first delta save is the chain base (a full save) — report
+        # it separately; steady state is everything after it.
+        steady_bytes = delta_bytes[1:] or delta_bytes
+        steady_ms = delta_ms[1:] or delta_ms
+        fb = statistics.median(full_bytes)
+        db = statistics.median(steady_bytes)
+        record = {
+            "model": args.model,
+            "mode": "delta_vs_full",
+            "churn": args.churn,
+            "churned_leaves": int(churned),
+            "saves": args.saves,
+            "full_save_ms": round(statistics.median(full_ms), 3),
+            "full_bytes": int(fb),
+            "delta_save_ms": round(statistics.median(steady_ms), 3),
+            "delta_bytes": int(db),
+            "delta_first_bytes": int(delta_bytes[0]),
+            "bytes_reduction_x": round(fb / max(db, 1), 1),
+        }
+        print(json.dumps(record))
+        return record
+    finally:
+        if args.ckpt_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def _spawn_ranks(argv, processes: int) -> int:
     """Parent mode: respawn this script as N loopback-distributed ranks;
     forward rank 0's output (the JSON record)."""
@@ -203,7 +321,22 @@ def main(argv=None):
                    help="dispatched train-ish steps between saves")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="scratch directory (default: a fresh temp dir)")
+    p.add_argument("--delta", action="store_true",
+                   help="bench the content-addressed delta store vs full "
+                        "whole-tree saves on the frozen-backbone churn "
+                        "profile (bytes written + save stall per arm)")
+    p.add_argument("--churn", type=str, default="fc5|fc_out",
+                   help="regex over tree paths naming the leaves that "
+                        "move between saves in the --delta profile "
+                        "(default: the classifier head — params and "
+                        "their mirrored optimizer moments)")
     args = p.parse_args(argv)
+
+    if args.delta:
+        if args.processes > 1:
+            raise SystemExit("--delta benches the single-process sync "
+                             "arms; drop --processes")
+        return run_delta_bench(args)
 
     worker_rank = os.environ.get("DWT_PROCESS_ID")
     if args.processes > 1 and worker_rank is None:
