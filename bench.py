@@ -83,7 +83,9 @@ _ANALYTIC_TRAIN_FLOPS_PER_IMG = {
 }
 
 
-def _bench_lenet(steps: int, batch: int):
+def _build_lenet(batch: int):
+    """Model/state/batch + jitted train step for the digits benchmarks
+    (shared with the --harvest_depth record-path sweep)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -107,6 +109,11 @@ def _bench_lenet(steps: int, batch: int):
         model, jax.random.key(0), jnp.stack([b["source_x"], b["target_x"]]), tx
     )
     step = jax.jit(make_digits_train_step(model, tx, 0.1), donate_argnums=0)
+    return step, state, b
+
+
+def _bench_lenet(steps: int, batch: int):
+    step, state, b = _build_lenet(batch)
     return _time_steps(step, state, b, steps, imgs_per_step=2 * batch)
 
 
@@ -181,8 +188,11 @@ def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
     return model, tx, state, b
 
 
-def _bench_resnet50(steps: int, batch: int, image: int = 224,
-                    use_pallas: bool = False):
+def _build_resnet50_step(batch: int, image: int = 224,
+                         use_pallas: bool = False):
+    """Flagship jitted train step + state/batch — ONE construction site
+    shared by the main bench and the --harvest_depth sweep so the two
+    can never measure divergent step recipes."""
     import jax
 
     from dwt_tpu.train import make_officehome_train_step
@@ -191,6 +201,12 @@ def _bench_resnet50(steps: int, batch: int, image: int = 224,
     step = jax.jit(
         make_officehome_train_step(model, tx, 0.1), donate_argnums=0
     )
+    return step, state, b
+
+
+def _bench_resnet50(steps: int, batch: int, image: int = 224,
+                    use_pallas: bool = False):
+    step, state, b = _build_resnet50_step(batch, image, use_pallas)
     return _time_steps(step, state, b, steps, imgs_per_step=3 * batch)
 
 
@@ -345,6 +361,100 @@ def scan_two_point(raw_step, state, batch, steps, k):
         run_k, state, batch, calls
     )
     return per_call / k, state, loss, degraded
+
+
+def harvest_record_bench(step, state, batch, steps, depth, warmup=3):
+    """Per-step wall of the RECORD path: dispatch + per-step metric
+    handling through ``train/harvest.py``'s ring at ``depth`` (0 = the
+    legacy synchronous ``float()``), log cadence 1 so EVERY step emits a
+    record into a host-side sink.
+
+    This is the A/B behind PERF.md "Hot-path harvest": the step benches
+    above deliberately fetch once per timed run, so the per-step fetch
+    tax the training loops actually pay (79.6% of loop wall in the PR-8
+    attribution) is invisible to them by design.  Two-point timing like
+    :func:`two_point_per_step`; every run ends with a full drain so the
+    deferred fetch work is always inside the timed region.  Shared with
+    ``tools/profile_step.py`` so the two tools' sweeps stay comparable.
+    """
+    from dwt_tpu.train.harvest import AsyncMetricHarvester
+
+    sink = []
+
+    def emit(vals):
+        sink.append(float(vals["loss"]))
+
+    def run(n, state):
+        h = AsyncMetricHarvester(depth)
+        t0 = time.perf_counter()
+        for i in range(n):
+            state, m = step(state, batch)
+            h.put(i + 1, i + 1, values={"loss": m["loss"]}, emit=emit)
+        h.drain()
+        return time.perf_counter() - t0, state
+
+    _, state = run(warmup, state)
+    n1 = max(1, steps // 4)
+    n2 = max(steps, n1 + 4)
+    dt1, state = run(n1, state)
+    dt2, state = run(n2, state)
+    per_step = (dt2 - dt1) / (n2 - n1)
+    degraded = per_step <= 0
+    if degraded:
+        # Timing jitter on very fast steps: the single-run average
+        # RE-INCLUDES the fixed round-trips two-point timing cancels —
+        # surfaced to the caller (like two_point_per_step) so a gated
+        # record never silently mixes methodologies across runs.
+        per_step = dt2 / n2
+        print(
+            f"bench: harvest depth={depth} two-point degenerate "
+            "(dt2<=dt1); reporting single-run average",
+            file=sys.stderr,
+        )
+    assert sink and all(s == s for s in sink), "non-finite loss in bench"
+    return per_step, state, degraded
+
+
+def _harvest_sweep(args, record):
+    """The ``--harvest_depth`` sweep arm: record-path ms/step per listed
+    ring depth, stamped into the bench record so ``--compare`` (through
+    tools/obs_diff.py) gates the trajectory instead of eyeballing it."""
+    depths = []
+    for tok in str(args.harvest_depth).split(","):
+        tok = tok.strip()
+        if tok:
+            depths.append(int(tok))
+    if not depths:
+        return
+    if args.model == "lenet":
+        step, state, b = _build_lenet(args.batch or 32)
+    else:
+        step, state, b = _build_resnet50_step(
+            args.batch or 18, args.image, use_pallas=args.pallas
+        )
+    step, _ = _compile_with_flops(step, state, b)
+    times = {}
+    any_degraded = False
+    for d in depths:
+        per_step, state, degraded = harvest_record_bench(
+            step, state, b, args.steps, d
+        )
+        times[d] = per_step
+        record[f"harvest_d{d}_ms_per_step"] = round(per_step * 1e3, 3)
+        if degraded:
+            # Bool fields are ignored by obs_diff's numeric extraction,
+            # so the marker rides the record without becoming a gated
+            # metric itself.
+            record[f"harvest_d{d}_degraded"] = True
+            any_degraded = True
+    deepest = max(times)
+    if (
+        0 in times and deepest > 0 and times[deepest] > 0
+        and not any_degraded  # a mixed-methodology ratio gates nothing
+    ):
+        record["harvest_record_speedup"] = round(
+            times[0] / times[deepest], 3
+        )
 
 
 def timing_label(scan_k: int, degraded: bool) -> str:
@@ -548,6 +658,10 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         steps = min(args.steps, 5)
     if getattr(args, "obs_trace", None):
         model_args += ["--obs_trace", args.obs_trace]
+    if getattr(args, "harvest_depth", None):
+        # The sweep arm rides the fallback too (the record path is a
+        # host-side mechanism — its A/B is meaningful on any backend).
+        model_args += ["--harvest_depth", args.harvest_depth]
     if getattr(args, "compare", None):
         # The gate rides the fallback too: a CPU rerun still compares
         # against the baseline (like-for-like metric names make a TPU
@@ -603,6 +717,17 @@ def main():
         "inference test() path (target branch, running stats)",
     )
     ap.add_argument(
+        "--harvest_depth",
+        default=None,
+        metavar="D0,D1,...",
+        help="sweep arm (ISSUE-14): also time the RECORD path — "
+        "dispatch + per-step metric handling through the "
+        "train/harvest.py ring — at each listed depth (e.g. '0,2' for "
+        "the sync-vs-async A/B).  Adds harvest_d<N>_ms_per_step fields "
+        "(plus harvest_record_speedup when 0 and a deeper arm are both "
+        "listed) to the record; --compare gates them like any metric",
+    )
+    ap.add_argument(
         "--no-probe",
         action="store_true",
         help="skip the subprocess backend probe (fallback path)",
@@ -635,6 +760,9 @@ def main():
         ap.error("--pallas only applies to --model resnet50")
     if args.pallas and args.phase == "eval":
         ap.error("--pallas is a training-path A/B; use --phase train")
+    if args.harvest_depth and args.phase == "eval":
+        ap.error("--harvest_depth sweeps the TRAIN record path; "
+                 "use --phase train")
 
     if not args.no_probe:
         # The subprocess jax probe is AUTHORITATIVE; the TCP port poll is
@@ -777,6 +905,8 @@ def main():
         record["image_size"] = args.image
     if args.fallback_note:
         record["fallback"] = args.fallback_note
+    if args.harvest_depth:
+        _harvest_sweep(args, record)
     obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     print(json.dumps(record))
     if args.compare:

@@ -101,6 +101,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "across the whole pass (O(1) host fetches) and "
                         "the 10-pass stat-collection protocol dispatches "
                         "at the same granularity")
+    p.add_argument("--harvest_depth", type=int, default=d.harvest_depth,
+                   help="async metric harvesting: depth of the bounded "
+                        "ring deferring the train-record host fetch "
+                        "(amortized 1/depth syncs per step; full drains "
+                        "at eval/ckpt/preempt/rollback boundaries; "
+                        "byte-identical records with original step "
+                        "stamps; guard staleness <= depth).  0 = legacy "
+                        "synchronous fetch")
     p.add_argument("--init_ckpt", type=str, default=None,
                    help="read-only Orbax init artifact (written by "
                         "dwt-convert); unlike --ckpt_dir it is never "

@@ -81,6 +81,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "stay device-resident across the whole eval "
                         "pass (O(1) host fetches), ragged tails are "
                         "pad-and-masked so counts stay exact")
+    p.add_argument("--harvest_depth", type=int, default=d.harvest_depth,
+                   help="async metric harvesting: depth of the bounded "
+                        "ring deferring the train-record host fetch "
+                        "(non-blocking device→host copies, drained once "
+                        "full — amortized 1/depth syncs per step — or "
+                        "fully at eval/ckpt/preempt/rollback "
+                        "boundaries); records keep their original step "
+                        "stamps byte-identically, and the divergence "
+                        "guard reads the step's harvested finite flag "
+                        "with staleness <= depth.  0 = legacy "
+                        "synchronous fetch")
     p.add_argument("--ckpt_dir", type=str, default=None)
     p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs)
     p.add_argument("--async_ckpt", action=argparse.BooleanOptionalAction,

@@ -68,6 +68,20 @@ class DigitsConfig:
     # pad-and-mask; default >1 because the eval path has no optimizer
     # state to perturb and the amortization is pure win.
     eval_steps_per_dispatch: int = 8
+    # Async metric harvesting (train/harvest.py): depth of the bounded
+    # ring deferring the train-record host fetch — each dispatch starts
+    # a non-blocking device→host metric copy and the ring drains once
+    # full (amortized 1/depth host syncs per step) or at eval/ckpt/
+    # preempt/final/rollback boundaries.  Records stay byte-identical
+    # with their original step stamps ACROSS DEPTHS (0 vs N emit the
+    # same bytes modulo wall-clock fields); the divergence guard reads
+    # the step's harvested finite flag with staleness <= depth.  0 =
+    # legacy synchronous fetch + legacy guard check.  Train-record step
+    # stamps are host-side at every depth (the per-record int(state.step)
+    # read was itself a sync), so after an in-memory guard recovery they
+    # keep counting while state.step rewinds — officehome's established
+    # semantics since the scanned-dispatch work, now uniform.
+    harvest_depth: int = 2
     ckpt_dir: Optional[str] = None
     ckpt_every_epochs: int = 10
     # >0: prune the MAIN ckpt_dir to the newest N steps after each
@@ -194,6 +208,8 @@ class OfficeHomeConfig:
     # DigitsConfig.eval_steps_per_dispatch.  Also governs the 10-pass
     # stat-collection protocol's dispatch granularity.
     eval_steps_per_dispatch: int = 8
+    # Async metric-harvest ring depth — see DigitsConfig.harvest_depth.
+    harvest_depth: int = 2
     init_ckpt: Optional[str] = None  # read-only Orbax init (dwt-convert)
     ckpt_dir: Optional[str] = None
     ckpt_every_iters: int = 1000

@@ -15,8 +15,14 @@ wired into the hang watchdog and divergence-guard event paths.
 
 Span categories (the report tool groups by these):
 
-* ``step`` — top-level, non-overlapping phases of the TRAIN loop's main
-  thread; their sum vs the loop wall time is the attribution table.
+* ``step`` — top-level phases of the TRAIN loop's main thread; their
+  self-time sum vs the loop wall time is the attribution table.  The
+  metric-harvest pipeline (ISSUE-14) contributes ``metric_copy_start``
+  (non-blocking device→host copy enqueue), ``harvest_drain`` (the
+  drain site), and the nested ``metric_host_fetch`` — which keeps its
+  historical name for the one genuinely BLOCKING materialization, so
+  the fetch collapse shows up in the same row the 79.6% attribution
+  used.
 * ``eval`` — eval/stat-collection pipeline internals.
 * ``ckpt`` — checkpoint pipeline (writer-thread writes, host fetch,
   promotion, barriers).

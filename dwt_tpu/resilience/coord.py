@@ -33,6 +33,14 @@ multi-host async writer's filesystem rendezvous) and the any-host
 preemption *notice* flag (scheduler warning before SIGTERM → all-host
 proactive save at the same boundary).
 
+With async metric harvesting (ISSUE-14, ``--harvest_depth > 0``) the
+``event`` bit is fed from the guard's *harvested* finite-flag verdicts
+(``DivergenceGuard.check_harvested``): the flags drain in lockstep on
+every host (same ring policy, same boundaries), so a metrics NaN fires
+the same rung everywhere at the same boundary and the vector still
+costs exactly one allgather — zero extra collectives.  Only host-LOCAL
+faults reach the remote-mirror path, exactly as before.
+
 Single-process runs short-circuit: :meth:`decide` returns the local
 flags without touching any collective or device API — the PR-1 behavior
 at zero overhead.  ``enabled=True`` forces the allgather path even at

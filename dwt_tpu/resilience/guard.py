@@ -29,10 +29,27 @@ Recovery is a LADDER, mildest rung first:
 * ``halt`` — raise :class:`DivergenceError`; the scheduler/operator sees
   a failed job instead of a silently-ruined one.  ``rollback`` escalates
   here after ``max_rollbacks`` attempts.
+
+Harvested mode (ISSUE-14): with ``--harvest_depth > 0`` the train step
+computes a device-side ``finite`` flag and the
+:class:`~dwt_tpu.train.harvest.AsyncMetricHarvester` delivers the
+materialized flags to :meth:`DivergenceGuard.observe_flags` as they
+drain — so the guard inspects one host-side bool per step instead of
+forcing the whole metrics tree, at ZERO host syncs of its own.  The
+verdict is stale by at most the ring depth: a NaN at step *s* is
+detected by the boundary at *s + depth*.  Correctness under that lag
+rests on a bounded snapshot *history*: passing checks push
+``(step, snapshot)`` pairs, and a bad flag for step *s* reverts to the
+newest snapshot strictly OLDER than *s* — a snapshot taken inside the
+undrained window may already be poisoned (NaN is absorbing) and is
+discarded.  Rollback still lands a pre-NaN checkpoint through the
+existing save-side finite gate (``save_state`` refuses non-finite
+params, so a post-NaN state never becomes a restore candidate).
 """
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Optional
 
 POLICIES = ("none", "halt", "skip_step", "rollback")
@@ -114,6 +131,28 @@ class DivergenceGuard:
         # failed), while this host's passing check just did.
         self._prev_good: Optional[Any] = None
         self._verdict_fn = None
+        # Harvested mode (enable_harvest): bounded (step, snapshot)
+        # history + the earliest not-yet-acted-on bad step the harvester
+        # observed.  None = legacy synchronous-metrics mode.
+        self._snaps: Optional[collections.deque] = None
+        self._pending_bad: Optional[int] = None
+        self.harvest_depth = 0
+        # Bad step behind the most recent harvested verdict (-1 = none):
+        # piggybacked on the consensus vector for EVENT_RECOVERED so
+        # mirror hosts align their snapshot history with this host's.
+        self.last_bad_step = -1
+        # Most recent backoff episode as [engage_step, recover_step or
+        # None]: under harvested verdicts a strike's flag can drain
+        # AFTER the scale already recovered — a bad step inside the
+        # episode must still escalate ("strike while backed off is
+        # persistent"), or a recurring divergence could loop
+        # backoff/recover forever without reaching the policy.
+        self._backoff_span: Optional[list] = None
+        # Deterministic prune floor for the snapshot history (set by
+        # enable_harvest): oldest step any still-pending flag could
+        # cover, derived from put control flow — identical on every
+        # host, so lockstep histories prune identically.
+        self._floor_fn = None
 
     # ------------------------------------------------------------- internals
 
@@ -172,6 +211,48 @@ class DivergenceGuard:
         if self._keeps_good:
             self._good = _snapshot(state)
             self._prev_good = self._good
+            if self._snaps is not None:
+                # Re-prime after a rollback restore: the history restarts
+                # at the restored state, and any verdicts still pending
+                # from the poisoned trajectory are void (the harvester's
+                # generation fence already made its in-flight flags
+                # inert; this clears an observed-but-unacted one).
+                self._snaps.clear()
+                self._snaps.append((int(state.step), self._good))
+                self._pending_bad = None
+                # The replay's step numbers rewind below the old episode
+                # bounds: reset the span to the replay trajectory — open
+                # at the restored step when the scale is still reduced
+                # (reapply_backoff), gone otherwise.
+                self._backoff_span = (
+                    [int(state.step), None] if self.in_backoff else None
+                )
+
+    def enable_harvest(self, depth: int, start_step: int,
+                       floor_fn=None) -> None:
+        """Switch to harvested-flag verdicts (see module docstring).
+
+        ``depth`` bounds the snapshot history: between two drains at most
+        ``depth`` boundaries pass, so ``depth + 2`` retained snapshots
+        always include one strictly older than any bad step still in
+        flight — the guard's worst-case device memory is ``depth + 2``
+        state copies (vs the legacy guard's 2).  ``floor_fn`` (the
+        harvester's :meth:`~dwt_tpu.train.harvest.AsyncMetricHarvester.
+        pending_floor`) prunes that back toward 2 in steady state: it
+        returns the oldest step any still-pending flag could cover,
+        computed from put CONTROL FLOW (not local drain timing), so
+        every host prunes the same entries in lockstep.  Call after
+        :meth:`prime`."""
+        self.harvest_depth = max(1, int(depth))
+        self._snaps = collections.deque(maxlen=self.harvest_depth + 2)
+        self._pending_bad = None
+        self._floor_fn = floor_fn
+        if self._good is not None:
+            self._snaps.append((int(start_step), self._good))
+
+    @property
+    def harvest_enabled(self) -> bool:
+        return self._snaps is not None
 
     @property
     def good_state(self) -> Optional[Any]:
@@ -212,6 +293,8 @@ class DivergenceGuard:
                 self._clean_checks += 1
                 if self._clean_checks >= self.backoff_recovery:
                     state = self._set_scale(state, 1.0)
+                    if self._backoff_span is not None:
+                        self._backoff_span[1] = int(step_no)
                     self._log("lr_recover", step_no, scale=1.0,
                               clean_checks=self._clean_checks)
             if self._keeps_good:
@@ -220,7 +303,101 @@ class DivergenceGuard:
             return state
         return self._diverged(state, step_no)
 
-    def mirror_recovery(self, state: Any, step_no: int) -> Any:
+    # -------------------------------------------------- harvested verdicts
+
+    def observe_flags(self, lo: int, hi: int, flags: Any) -> None:
+        """Record the harvested finite verdict for steps ``[lo, hi]``
+        (host-side bool scalar, or ``[n]`` array on the chunked path).
+        Pure bookkeeping — never raises, never syncs; the rung fires at
+        the next step boundary via :meth:`check_harvested`."""
+        import numpy as np
+
+        arr = np.atleast_1d(np.asarray(flags)).astype(bool)
+        if bool(arr.all()):
+            return
+        bad = int(lo) + int(np.argmax(~arr))  # first non-finite step
+        if self._pending_bad is None or bad < self._pending_bad:
+            self._pending_bad = bad
+
+    def check_harvested(self, state: Any, n_steps: int, step_no: int) -> Any:
+        """The harvested-mode boundary check: act on any observed bad
+        flag IMMEDIATELY (the inspection is a host bool — free — so
+        detection lags only the harvest ring, not the check interval);
+        otherwise run the interval-amortized bookkeeping (backoff
+        recovery, snapshot refresh) exactly like :meth:`step` — the
+        snapshot's jitted device copy is the cost ``interval`` still
+        amortizes."""
+        if self._pending_bad is not None:
+            bad = self._pending_bad
+            self._pending_bad = None
+            # Remember the bad step for the consensus: an in-memory
+            # recovery's EVENT_RECOVERED bit carries it on the vector's
+            # rollback_step slot, so mirror hosts can discard the SAME
+            # snapshots this host is about to (see mirror_recovery).
+            self.last_bad_step = bad
+            self._revert_history_to(bad)
+            return self._diverged(state, bad, detected_at=step_no)
+        self._since_check += n_steps
+        if self._since_check < self.interval:
+            return state
+        self._since_check = 0
+        if self.in_backoff:
+            self._clean_checks += 1
+            if self._clean_checks >= self.backoff_recovery:
+                state = self._set_scale(state, 1.0)
+                if self._backoff_span is not None:
+                    # Close the episode: a bad flag still in flight for
+                    # a step inside it escalates when it drains, even
+                    # though the scale already recovered (_diverged).
+                    self._backoff_span[1] = int(step_no)
+                self._log("lr_recover", step_no, scale=1.0,
+                          clean_checks=self._clean_checks)
+        if self._keeps_good:
+            self._snaps.append((int(step_no), _snapshot(state)))
+            self._prune_history()
+            self._sync_good_fields()
+        return state
+
+    def _sync_good_fields(self) -> None:
+        """Keep ``_good``/``_prev_good`` (the fields every rung and the
+        multi-host mirror read) pointing at the newest two history
+        entries."""
+        if not self._snaps:
+            return
+        self._good = self._snaps[-1][1]
+        self._prev_good = (
+            self._snaps[-2][1] if len(self._snaps) > 1 else self._snaps[-1][1]
+        )
+
+    def _prune_history(self) -> None:
+        """Drop history entries no future bad step can need: a pending
+        flag covers at earliest ``floor_fn()``, so only the newest
+        snapshot strictly below that floor (the revert target for the
+        worst case) plus everything newer must stay.  Keeps the guard's
+        steady-state memory at ~2 state copies instead of depth + 2."""
+        if self._floor_fn is None or self._snaps is None:
+            return
+        floor = self._floor_fn()
+        if floor is None:
+            return
+        while len(self._snaps) >= 2 and self._snaps[1][0] < floor:
+            self._snaps.popleft()
+
+    def _revert_history_to(self, bad_step: int) -> None:
+        """Discard snapshots taken at or after ``bad_step``: a check
+        boundary inside the undrained window refreshed the snapshot from
+        a state the flag now proves poisoned (NaN is absorbing), and
+        reverting to it would replay NaN at a smaller step size.  The
+        oldest entry is always kept — it predates every in-flight flag
+        by construction of the history bound."""
+        if self._snaps is None:
+            return
+        while len(self._snaps) > 1 and self._snaps[-1][0] >= bad_step:
+            self._snaps.pop()
+        self._sync_good_fields()
+
+    def mirror_recovery(self, state: Any, step_no: int,
+                        bad_step: int = -1) -> Any:
         """Perform the divergence rung WITHOUT a local verdict: the
         step-boundary consensus reported another host's guard fired while
         this host's metrics looked finite (a host-local fault preceding
@@ -231,25 +408,57 @@ class DivergenceGuard:
 
         This host's check PASSED at this boundary, refreshing ``_good``
         to the current state — a snapshot the remote (failed-check) host
-        never took.  Reverting must target the snapshot BOTH hosts hold,
-        the one from the previous passing check, so the refresh is
-        rolled back first.
+        never took.  Reverting must target the snapshot BOTH hosts hold:
+        in harvested mode the consensus carries the remote's ``bad_step``
+        (on the vector's rollback_step slot), so this host discards
+        exactly the snapshots the remote discarded — the histories were
+        pushed in lockstep, and the firing host's own detection-boundary
+        refresh never happened (its check failed), which
+        ``_revert_history_to`` removes here too (that snapshot's step is
+        >= the bad step).  Legacy mode keeps the one-refresh rollback to
+        ``_prev_good``.
         """
-        if self._prev_good is not None:
+        if self._snaps is not None:
+            if bad_step >= 0:
+                self._revert_history_to(bad_step)
+            elif len(self._snaps) > 1:
+                # No bad step on the wire (legacy peer / defensive):
+                # drop this boundary's refresh, the one snapshot the
+                # remote host never took.
+                self._snaps.pop()
+                self._sync_good_fields()
+        elif self._prev_good is not None:
             self._good = self._prev_good
         return self._diverged(state, step_no)
 
-    def _diverged(self, state: Any, step_no: int) -> Any:
+    def _diverged(self, state: Any, step_no: int,
+                  detected_at: Optional[int] = None) -> Any:
         self._log(
-            "divergence", step_no, policy=self.policy, scale=self._scale
+            "divergence", step_no, policy=self.policy, scale=self._scale,
+            # Harvested mode: the verdict for step_no was acted on at
+            # this (later) boundary — the staleness the chaos tests pin
+            # to <= the harvest depth.
+            **({} if detected_at is None else {"detected_at": detected_at}),
         )
-        if self.lr_backoff and not self.in_backoff and self._good is not None:
+        # "Strike while backed off is persistent → escalate": under
+        # harvested verdicts the strike's flag can drain AFTER the scale
+        # already recovered, so a bad STEP inside the last backoff
+        # episode (it ran at reduced lr) escalates even when in_backoff
+        # is False by now — without this, a recurring divergence could
+        # loop backoff/recover forever and never reach the policy.
+        struck_backed_off = self.in_backoff or (
+            self._backoff_span is not None
+            and self._backoff_span[0] < step_no
+            and (self._backoff_span[1] is None
+                 or step_no <= self._backoff_span[1])
+        )
+        if self.lr_backoff and not struck_backed_off and self._good is not None:
             # Rung 1: revert to the last good state, train gently.  Only
-            # when not ALREADY backed off — a strike at reduced lr is
-            # persistent and falls through to the configured policy.
+            # when not (even retroactively) backed off — see above.
             self.backoffs += 1
             self.recoveries += 1
             self._clean_checks = 0
+            self._backoff_span = [int(step_no), None]
             recovered = self._set_scale(self.good_state, self.lr_backoff)
             self._log("lr_backoff", step_no, scale=self.lr_backoff,
                       backoffs=self.backoffs)

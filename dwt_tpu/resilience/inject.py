@@ -379,7 +379,17 @@ def maybe_nan(state, metrics, lo: int, hi: Optional[int] = None) -> Tuple[Any, A
     remaining = [s for s in steps if s not in hit]  # each element one-shot
     plan.nan_at_step = remaining or None
     state = state.replace(params=_poison_tree(state.params))
-    return state, _poison_tree(dict(metrics))
+    metrics = _poison_tree(dict(metrics))
+    if "finite" in metrics:
+        # The step's device-side finite flag (train/steps.py) was
+        # computed from the REAL metrics before this injection; a real
+        # NaN would have flipped it, so the simulated one must too — or
+        # the harvested guard (--harvest_depth) would never see the
+        # poison it is being tested against.
+        import jax.numpy as jnp
+
+        metrics["finite"] = jnp.zeros_like(metrics["finite"])
+    return state, metrics
 
 
 def maybe_crash_mid_save(step: int) -> None:

@@ -1,0 +1,302 @@
+"""Async metric harvesting: the last per-step host sync off the hot path.
+
+PR 8's attribution (``tools/obs_report.py`` over a traced digits run)
+measured ``metric_host_fetch`` — the ``float()`` materialization in the
+train-record path — at 79.6% of per-step loop wall: it is exactly where
+async-dispatched step work gets waited on, because a blocking device→host
+read of step *s*'s metrics cannot complete before step *s* itself does.
+The training algorithm only *consumes* these scalars at logging/guard
+cadence, so nothing requires the read to be synchronous.
+
+:class:`AsyncMetricHarvester` is the deferred pipeline: each dispatch
+enqueues its (step-stamped) metrics into a bounded ring after starting a
+non-blocking device→host copy (``copy_to_host_async``), and entries are
+drained — materialized and emitted as byte-identical ``MetricLogger``
+records carrying their *original* step stamps — only once the ring fills
+(or at eval/checkpoint/preempt/final/rollback boundaries, which drain
+fully).  A full ring is drained with ONE blocking rendezvous for all
+``depth`` entries, so the amortized per-step host-sync count drops from
+1 to 1/depth — and by the time the ring has refilled, the oldest copies
+completed long ago, so the rendezvous waits essentially on the newest
+entry alone.
+
+Contracts, load-bearing for the loops:
+
+* **exact records, nothing lost or reordered** — the ring is FIFO and
+  every boundary drain flushes it completely; the emitted JSONL records
+  are byte-identical (modulo wall-clock fields) to the synchronous
+  path's, with their original step stamps.
+* **depth 0 = legacy synchronous fetch** — ``put`` materializes and
+  emits immediately (one sync per record-bearing step), bitwise record
+  parity with the async path by construction (same emit closure).
+* **bounded guard staleness** — the train step computes a device-side
+  ``finite`` flag (one bool scalar; the guard inspects it instead of
+  forcing the whole metrics tree), harvested through the same ring: a
+  NaN at step *s* reaches :meth:`DivergenceGuard.observe_flags` by the
+  drain at *s + depth* entries, so detection lags at most ``depth``
+  dispatches on top of the existing ``--guard_interval`` amortization.
+* **generation fencing** — after a guard recovery the ring may still
+  hold entries from the poisoned trajectory; :meth:`bump_generation`
+  makes their flags inert (the records still emit — they narrate steps
+  that really ran) so a replayed segment is never re-tripped by stale
+  verdicts.
+
+Spans (``dwt_tpu.obs``): ``metric_copy_start`` books the enqueue +
+async-copy dispatch, ``harvest_drain`` the drain site, and the nested
+``metric_host_fetch`` keeps its name for the one genuinely blocking
+materialization — so the attribution table shows the fetch share
+collapse rather than hiding it under a new label.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dwt_tpu import obs
+
+
+class _Entry:
+    """One dispatch's booked metrics: step range, the device scalars the
+    record needs, the optional finite flag, and the emit closure."""
+
+    __slots__ = ("lo", "hi", "values", "flag", "emit", "gen")
+
+    def __init__(self, lo: int, hi: int, values: Dict[str, Any],
+                 flag: Any, emit: Optional[Callable], gen: int):
+        self.lo = lo
+        self.hi = hi
+        self.values = values
+        self.flag = flag
+        self.emit = emit
+        self.gen = gen
+
+    def arrays(self):
+        for v in self.values.values():
+            yield v
+        if self.flag is not None:
+            yield self.flag
+
+    def ready(self) -> bool:
+        """All leaves computed (``jax.Array.is_ready`` — a host-side
+        queue poll, NOT a sync); host-resident leaves are trivially
+        ready."""
+        for a in self.arrays():
+            probe = getattr(a, "is_ready", None)
+            if probe is not None and not probe():
+                return False
+        return True
+
+
+def _start_copy(arr: Any) -> None:
+    # jax.Array exposes copy_to_host_async; plain numpy (tests, depth-0
+    # shortcuts) has nothing to start.
+    start = getattr(arr, "copy_to_host_async", None)
+    if start is not None:
+        start()
+
+
+class AsyncMetricHarvester:
+    """Bounded-ring deferred metric pipeline (see module docstring).
+
+    ``flag_observer(lo, hi, host_flags)`` — typically
+    ``DivergenceGuard.observe_flags`` — receives each drained entry's
+    materialized finite flag(s) *before* the entry's records emit, and
+    only for entries of the current generation.
+
+    Main-thread only, like the loops that drive it: no locking.
+    """
+
+    def __init__(self, depth: int,
+                 flag_observer: Optional[Callable] = None):
+        self.depth = max(0, int(depth))
+        self._ring: "collections.deque[_Entry]" = collections.deque()
+        self._observer = flag_observer
+        self.generation = 0
+        self.puts = 0
+        self.emitted = 0
+        self.lag_steps = 0
+        self._last_put_hi: Optional[int] = None
+        # Lo-stamps of the last `depth` puts: the ring never holds more
+        # than `depth` entries after a put returns (overflow drains), so
+        # any still-pending flag covers at earliest _lo_history[0] —
+        # a bound derived from put CONTROL FLOW, not local drain timing,
+        # hence identical on every host (the guard's lockstep
+        # history-prune floor, pending_floor()).
+        self._lo_history: "collections.deque[int]" = collections.deque(
+            maxlen=max(self.depth, 1)
+        )
+        # Live metrics plane: both gauges are host-side integers the
+        # drain site already holds — zero new device syncs (spans.py /
+        # registry.py discipline).  Surfaced in /metrics on both
+        # training CLIs and mirrored into heartbeat records.
+        from dwt_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._g_ring = reg.gauge(
+            "dwt_harvest_ring_depth",
+            "metric-harvest entries in flight (ring occupancy)",
+        )
+        self._g_lag = reg.gauge(
+            "dwt_harvest_lag_steps",
+            "staleness of the oldest harvested metrics at the last "
+            "drain, in steps",
+        )
+        self._g_ring.set(0)
+        self._g_lag.set(0)
+
+    # ----------------------------------------------------------- recording
+
+    @property
+    def async_mode(self) -> bool:
+        return self.depth > 0
+
+    @property
+    def pending(self) -> int:
+        return len(self._ring)
+
+    def put(self, lo: int, hi: int, values: Optional[Dict[str, Any]] = None,
+            flag: Any = None, emit: Optional[Callable] = None) -> None:
+        """Book the metrics of steps ``[lo, hi]`` (one step per dispatch
+        on the per-step paths; a chunk's range on the scanned path, with
+        ``[n]``-stacked leaves).
+
+        ``values`` holds exactly the device scalars ``emit`` will need
+        (None when this step logs nothing), ``flag`` the device-side
+        finite verdict (None when no guard consumes it) — so a
+        non-logging step with an active guard copies ONE bool, not the
+        whole metrics tree.  Nothing to book at all → no ring entry.
+        """
+        if values is None and flag is None:
+            return
+        self.puts += 1
+        self._last_put_hi = int(hi)
+        e = _Entry(int(lo), int(hi), values or {}, flag, emit,
+                   self.generation)
+        if self.depth == 0:
+            # Legacy synchronous fetch: materialize + emit in place.
+            with obs.span("metric_host_fetch"):
+                host = self._wait([e])
+            self._emit(e, host[0])
+            return
+        with obs.span("metric_copy_start"):
+            for arr in e.arrays():
+                _start_copy(arr)
+            self._ring.append(e)
+            self._lo_history.append(e.lo)
+        # Opportunistic drain: entries whose copies already landed emit
+        # now with NO blocking rendezvous (is_ready is a queue poll).
+        # FIFO discipline — only the ready PREFIX drains, so records
+        # never reorder around a still-in-flight older entry.
+        while self._ring and self._ring[0].ready():
+            entry = self._ring.popleft()
+            with obs.span("harvest_drain", n=1):
+                self._emit(entry, self._materialize(entry))
+        if len(self._ring) > self.depth:
+            # Ring overflow (device more than `depth` record-bearing
+            # dispatches behind): force a full drain — ONE blocking
+            # rendezvous for every pending entry, so even with nothing
+            # ever ready the amortized sync count is 1/depth per entry,
+            # not 1.
+            self.drain()
+        self._note_gauges()
+
+    def drain(self) -> None:
+        """Flush the whole ring: ONE blocking rendezvous materializes
+        every pending entry (the oldest copies completed long ago — the
+        wait is effectively on the newest), then the entries emit in
+        FIFO order.  Called by ``put`` on ring overflow and by the loops
+        at every eval/checkpoint/preempt/final/rollback boundary, so no
+        record is ever lost or reordered."""
+        if not self._ring:
+            return
+        entries = list(self._ring)
+        self._ring.clear()
+        if self._last_put_hi is not None:
+            self.lag_steps = self._last_put_hi - entries[0].lo
+            self._g_lag.set(self.lag_steps)
+        with obs.span("harvest_drain", n=len(entries)):
+            with obs.span("metric_host_fetch"):
+                hosts = self._wait(entries)
+            for e, host in zip(entries, hosts):
+                self._emit(e, host)
+        self._note_gauges()
+
+    def _note_gauges(self) -> None:
+        self._g_ring.set(len(self._ring))
+        if self._ring and self._last_put_hi is not None:
+            self.lag_steps = self._last_put_hi - self._ring[0].lo
+            self._g_lag.set(self.lag_steps)
+
+    def pending_floor(self) -> Optional[int]:
+        """Oldest step any still-pending flag could cover (None until
+        `depth` puts happened): the guard prunes snapshots strictly
+        below the newest one under this floor.  Deterministic across
+        hosts — see _lo_history."""
+        if len(self._lo_history) < max(self.depth, 1):
+            return None
+        return self._lo_history[0]
+
+    def bump_generation(self) -> None:
+        """Fence pending entries' flags: after a guard recovery the ring
+        still holds pre-recovery verdicts that must not re-trip the
+        guard on the replayed segment.  Their records still emit."""
+        self.generation += 1
+
+    def reset_stamps(self) -> None:
+        """Forget the put-stamp bookkeeping.  The rollback handlers call
+        this (right after their full drain) because the restore REWINDS
+        step numbering: a floor still derived from pre-rollback stamps
+        would make the guard prune the restore-point snapshot the replay
+        may yet need, and the lag gauge would report pre-rollback
+        deltas.  In-memory recoveries (lr_backoff/skip_step) keep
+        monotonic host numbering and must NOT reset."""
+        self._lo_history.clear()
+        self._last_put_hi = None
+
+    # ----------------------------------------------------------- internals
+
+    def _wait(self, entries: List[_Entry]) -> List[Tuple[dict, Any]]:
+        """THE blocking device→host rendezvous — the one countable host
+        sync on the record path (tests shim this to prove the 1 →
+        amortized <= 1/depth drop; opportunistic ready-drains never come
+        through here)."""
+        return [self._materialize(e) for e in entries]
+
+    @staticmethod
+    def _materialize(e: _Entry) -> Tuple[dict, Any]:
+        """``np.asarray`` on each leaf completes the async copy started
+        at ``put`` time; values come back as numpy scalars/arrays whose
+        ``float()`` is bitwise the device scalar's.  Non-blocking when
+        the entry is ready()."""
+        host_values = {k: np.asarray(v) for k, v in e.values.items()}
+        host_flag = None if e.flag is None else np.asarray(e.flag)
+        return host_values, host_flag
+
+    def _emit(self, e: _Entry, host: Tuple[dict, Any]) -> None:
+        host_values, host_flag = host
+        if (
+            host_flag is not None
+            and self._observer is not None
+            and e.gen == self.generation
+        ):
+            self._observer(e.lo, e.hi, host_flag)
+        if e.emit is not None:
+            e.emit(host_values)
+        self.emitted += 1
+
+
+def make_harvester(cfg, guard=None) -> AsyncMetricHarvester:
+    """The loops' one constructor: ``--harvest_depth`` (default 2; 0 =
+    legacy synchronous fetch) wired to the run's guard.  With an active
+    guard and depth > 0 the guard switches to harvested-flag verdicts
+    (:meth:`DivergenceGuard.enable_harvest`); at depth 0 the guard keeps
+    its PR-1 synchronous metrics check, so depth 0 is bitwise the
+    pre-harvest loop."""
+    depth = max(0, int(getattr(cfg, "harvest_depth", 2)))
+    observer = None
+    if guard is not None and depth > 0:
+        observer = guard.observe_flags
+    return AsyncMetricHarvester(depth, flag_observer=observer)

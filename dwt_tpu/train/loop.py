@@ -78,6 +78,7 @@ from dwt_tpu.train.optim import (
     with_lr_backoff,
 )
 from dwt_tpu.train.evalpipe import EvalPipeline
+from dwt_tpu.train.harvest import make_harvester
 from dwt_tpu.train.state import TrainState, create_train_state
 from dwt_tpu.train.steps import (
     make_digits_train_step,
@@ -479,7 +480,7 @@ class _StepBoundary:
 
     def __init__(self, guard, preempt, coord, watchdog, logger=None,
                  ckpt=None, notice_watcher=None, heartbeat=None,
-                 flight_dir=None, alerts=None):
+                 flight_dir=None, alerts=None, harvester=None):
         self.guard = guard
         self.preempt = preempt
         self.coord = coord
@@ -487,6 +488,19 @@ class _StepBoundary:
         self.logger = logger
         self.ckpt = ckpt
         self.notice_watcher = notice_watcher
+        # Async metric harvesting (ISSUE-14): when the run harvests
+        # (--harvest_depth > 0) and a guard is active, the guard verdict
+        # comes from harvested finite flags (check_harvested — zero host
+        # syncs at the boundary) instead of a blocking metrics fetch;
+        # guard events fence the harvester's in-flight entries
+        # (bump_generation) so a replayed segment is never re-tripped by
+        # stale pre-recovery verdicts.
+        self.harvester = harvester
+        self._harvest_guard = (
+            guard is not None
+            and harvester is not None
+            and harvester.async_mode
+        )
         # Live metrics plane: step/guard counters plus the --alert_rules
         # engine, evaluated once per boundary (internally throttled).
         # Counter feed is host-side integers only — no device syncs.
@@ -603,7 +617,12 @@ class _StepBoundary:
             recoveries_before = self.guard.recoveries
             try:
                 with obs.span("guard_check", "detail"):
-                    state = self.guard.step(state, metrics, n_steps, gstep)
+                    if self._harvest_guard:
+                        state = self.guard.check_harvested(
+                            state, n_steps, gstep
+                        )
+                    else:
+                        state = self.guard.step(state, metrics, n_steps, gstep)
                 if self.guard.recoveries != recoveries_before:
                     # lr_backoff/skip_step fired: no exception, but the
                     # other hosts must take the same rung.
@@ -618,13 +637,25 @@ class _StepBoundary:
             # seconds of spans — what every thread had been DOING —
             # dumped before any recovery path mutates the run's state.
             self._flight(f"guard_event_step{gstep}")
+            if self.harvester is not None:
+                # In-flight entries predate the recovery this event is
+                # about to run: their records still emit, their flags
+                # must not re-trip the guard on the replayed segment.
+                self.harvester.bump_generation()
         if self.coord.enabled:
             with obs.span("consensus_decide", "detail"):
                 decision = self.coord.decide(
                     stop=self.preempt.should_stop,
                     event=code,
+                    # The slot carries the rollback target for
+                    # EVENT_ROLLBACK, and the harvested bad step for an
+                    # in-memory EVENT_RECOVERED — so mirror hosts can
+                    # discard the same snapshots the firing host did
+                    # (guard.mirror_recovery).  Zero extra collectives.
                     rollback_step=(
                         event.step if isinstance(event, RollbackRequest)
+                        else self.guard.last_bad_step
+                        if code == EVENT_RECOVERED and self.guard is not None
                         else -1
                     ),
                     save_done_seq=(
@@ -662,6 +693,8 @@ class _StepBoundary:
                     event="remote_" + _EVENT_METRIC_NAMES[decision.event]
                 ).inc()
                 self._flight(f"remote_guard_event_step{gstep}")
+                if self.harvester is not None:
+                    self.harvester.bump_generation()  # see local fence
                 if decision.event == EVENT_ROLLBACK and self.guard is not None:
                     # Keep the rollback budget and the re-seed stride in
                     # lockstep with the host that fired: every process
@@ -675,7 +708,11 @@ class _StepBoundary:
                     # Same in-memory rung the remote host took (snapshots
                     # are replicated, so the recovered states agree); may
                     # itself escalate — consistently, ladders are in lock.
-                    state = self.guard.mirror_recovery(state, gstep)
+                    # rollback_step carries the remote's harvested bad
+                    # step so the histories discard the same snapshots.
+                    state = self.guard.mirror_recovery(
+                        state, gstep, bad_step=decision.rollback_step
+                    )
                     return state, self.stop
                 raise DivergenceError("divergence detected on another host")
             return state, self.stop
@@ -1209,6 +1246,53 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     epoch = start_epoch
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
     gstep = int(state.step)  # host-side global step count (guard/injection)
+    # Async metric harvesting (ISSUE-14): every hot-path record/verdict
+    # rides the bounded ring; with an active guard the divergence
+    # verdict comes from the step's harvested device-side finite flag
+    # (bounded staleness <= ring depth) instead of a blocking fetch.
+    harvester = make_harvester(cfg, guard)
+    flag_mode = guard is not None and harvester.async_mode
+    if flag_mode:
+        guard.enable_harvest(
+            harvester.depth, gstep, floor_fn=harvester.pending_floor
+        )
+
+    def _train_emit(step_no, ep):
+        # Record step numbers are host-side (gstep == int(state.step) on
+        # this path): reading state.step per record would be one more
+        # per-step device sync — exactly what the harvester removes.
+        # After an in-memory guard recovery (lr_backoff/skip_step) the
+        # host count keeps running while state.step rewinds — the same
+        # host-side stamping officehome's train records have always
+        # used (step0 + iter), now uniform across both loops.
+        def emit(vals):
+            logger.log(
+                "train", step_no, epoch=ep,
+                cls_loss=vals["cls_loss"],
+                entropy_loss=vals["entropy_loss"],
+            )
+            _note_losses(
+                cls_loss=vals["cls_loss"],
+                entropy_loss=vals["entropy_loss"],
+            )
+        return emit
+
+    def _chunk_emit(idxs, ep):
+        # idxs = [(row in the stacked metrics, record step number)] for
+        # the log-cadence inner steps of one dispatched chunk.
+        def emit(vals):
+            for jj, step_no in idxs:
+                logger.log(
+                    "train", step_no, epoch=ep,
+                    cls_loss=vals["cls_loss"][jj],
+                    entropy_loss=vals["entropy_loss"][jj],
+                )
+                _note_losses(
+                    cls_loss=vals["cls_loss"][jj],
+                    entropy_loss=vals["entropy_loss"][jj],
+                )
+        return emit
+
     with contextlib.ExitStack() as _cleanup, PreemptionHandler(
         logger
     ) as preempt, HangWatchdog(
@@ -1233,6 +1317,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 else None
             ),
             alerts=alert_engine,
+            harvester=harvester,
         )
 
         def _proactive_save(st):
@@ -1242,6 +1327,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             # window writing a second one.
             if not cfg.ckpt_dir:
                 return None
+            harvester.drain()  # checkpoint boundary: records before save
             step = int(st.step)
             with wd.suspended():  # save may legitimately outlast the timeout
                 ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
@@ -1283,9 +1369,12 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                     # Span phases (dwt_tpu.obs, near-free when off):
                     # batch_wait = wait on the prefetch/staging pipeline;
                     # step_dispatch = enqueue of the compiled step (NOT
-                    # device time — spans never sync); metric_host_fetch
-                    # = the float() materialization the train record
-                    # forces; boundary = guard/consensus/injection.
+                    # device time — spans never sync); metric_copy_start
+                    # = enqueue of the non-blocking device→host metric
+                    # copy; harvest_drain / nested metric_host_fetch =
+                    # the amortized drain and its one blocking
+                    # materialization; boundary = guard/consensus/
+                    # injection.
                     for i, batch in enumerate(
                         obs.traced_iter(batches, "batch_wait")
                     ):
@@ -1293,19 +1382,18 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                             state, metrics = train_step(state, batch)
                         gstep += 1
                         state, metrics = inject.maybe_nan(state, metrics, gstep)
+                        values = emit = None
                         if i % cfg.log_interval == 0:
-                            with obs.span("metric_host_fetch"):
-                                logger.log(
-                                    "train",
-                                    int(state.step),
-                                    epoch=epoch,
-                                    cls_loss=metrics["cls_loss"],
-                                    entropy_loss=metrics["entropy_loss"],
-                                )
-                                _note_losses(
-                                    cls_loss=metrics["cls_loss"],
-                                    entropy_loss=metrics["entropy_loss"],
-                                )
+                            values = {
+                                "cls_loss": metrics["cls_loss"],
+                                "entropy_loss": metrics["entropy_loss"],
+                            }
+                            emit = _train_emit(gstep, epoch)
+                        harvester.put(
+                            gstep, gstep, values=values,
+                            flag=metrics["finite"] if flag_mode else None,
+                            emit=emit,
+                        )
                         state, stop = boundary(state, metrics, 1, gstep)
                         if stop:
                             break
@@ -1326,21 +1414,27 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         lo = gstep + 1
                         gstep += n
                         st, ms = inject.maybe_nan(st, ms, lo, gstep)
-                        with obs.span("metric_host_fetch"):
-                            for j in range(pos, pos + n):
-                                if j % cfg.log_interval == 0:
-                                    jj = j - pos
-                                    logger.log(
-                                        "train",
-                                        step0 + j + 1,
-                                        epoch=epoch,
-                                        cls_loss=ms["cls_loss"][jj],
-                                        entropy_loss=ms["entropy_loss"][jj],
-                                    )
-                                    _note_losses(
-                                        cls_loss=ms["cls_loss"][jj],
-                                        entropy_loss=ms["entropy_loss"][jj],
-                                    )
+                        # The whole chunk's [n]-stacked metrics stream
+                        # through the SAME ring as the per-step path —
+                        # one entry per dispatch, per-inner-step records
+                        # emitted at drain time.
+                        idxs = [
+                            (j - pos, step0 + j + 1)
+                            for j in range(pos, pos + n)
+                            if j % cfg.log_interval == 0
+                        ]
+                        values = emit = None
+                        if idxs:
+                            values = {
+                                "cls_loss": ms["cls_loss"],
+                                "entropy_loss": ms["entropy_loss"],
+                            }
+                            emit = _chunk_emit(idxs, epoch)
+                        harvester.put(
+                            lo, gstep, values=values,
+                            flag=ms["finite"] if flag_mode else None,
+                            emit=emit,
+                        )
                         pos += n
                         return boundary(st, ms, n, gstep)
 
@@ -1354,6 +1448,15 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         on_steps,
                     )
             except RollbackRequest as rb:
+                # Drain the harvest ring first: the pending records
+                # narrate the steps that led into the divergence (their
+                # flags are generation-fenced — the boundary bumped it
+                # before raising, so the replay cannot be re-tripped).
+                harvester.drain()
+                # The restore below rewinds step numbering: stale
+                # pre-rollback put stamps would corrupt the guard's
+                # prune floor (pending_floor) and the lag gauge.
+                harvester.reset_stamps()
                 # Rendezvous: JOIN the in-flight save so the writer cannot
                 # race the restore's directory walk — but do NOT re-raise
                 # a stale writer error here: a failed periodic save
@@ -1382,6 +1485,14 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
                 continue
             finally:
+                # Boundary drain (ISSUE-14) on EVERY exit — normal epoch
+                # end (eval/preempt/final follow), rollback, and the
+                # raising paths (halt/DivergenceError, watchdog-visible
+                # errors): every pending record emits exactly once, in
+                # order, before any boundary record is written — a
+                # halted run's post-mortem keeps the train records
+                # leading into the divergence.
+                harvester.drain()
                 # Tear the pipeline down on EVERY exit (normal epoch end,
                 # rollback, preemption break, error): the prefetch close
                 # joins its producer thread, making the epoch-iterator
@@ -1663,12 +1774,36 @@ def run_officehome(
         # Gauge feed AFTER logger.log materialized the scalars: no new sync.
         _note_losses(cls_loss=cls, mec_loss=mec)
 
+    def _ckpt_targets(it):
+        # THE checkpoint-trigger predicate for this loop, stated once:
+        # the drain decision below and the save itself both derive from
+        # this list, so they cannot drift apart (a save with pending
+        # harvest entries would reorder records).  The steps-per-dispatch
+        # chunk cutter (should_cut) intentionally mirrors only the
+        # cadence arithmetic — a missed cut there costs one extra
+        # compile, never record ordering.
+        targets = []
+        if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
+            targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
+        if cfg.ckpt_dir and cfg.anchor_every and (
+            (it + 1) % cfg.anchor_every == 0
+        ):
+            targets.append((_anchor_dir(cfg.ckpt_dir), {}))
+        return targets
+
     def _boundary_actions(it):
         # Runs after the step at global index ``it``; with
         # steps_per_dispatch > 1, _chunk_stream cuts chunks at exactly
         # these indices so the cadences match the per-step loop.
         nonlocal acc, best_acc, state
-        if (it + 1) % cfg.check_acc_step == 0:
+        do_eval = (it + 1) % cfg.check_acc_step == 0
+        targets = _ckpt_targets(it)
+        if do_eval or targets:
+            # Eval/checkpoint boundaries drain the harvest ring fully:
+            # pending train records land before the test/checkpoint
+            # records they precede (ISSUE-14).
+            harvester.drain()
+        if do_eval:
             with obs.span("eval_pass", imgs=len(test_ds)):
                 result = evalp.evaluate(state, test_ds)
             wd.heartbeat()  # boundary eval is progress, not a stall
@@ -1698,13 +1833,6 @@ def run_officehome(
                     best_acc = acc
                     _write_best_record(cfg.ckpt_dir, acc, int(state.step))
                     logger.log("best", int(state.step), accuracy=acc)
-        targets = []
-        if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
-            targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
-        if cfg.ckpt_dir and cfg.anchor_every and (
-            (it + 1) % cfg.anchor_every == 0
-        ):
-            targets.append((_anchor_dir(cfg.ckpt_dir), {}))
         if targets:
             # Sync saves may block past the watchdog timeout (see
             # run_digits) — masked, not raced.
@@ -1719,6 +1847,30 @@ def run_officehome(
     if guard:
         guard.prime(state)
     seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
+    # Async metric harvesting (ISSUE-14) — see run_digits.
+    harvester = make_harvester(cfg, guard)
+    flag_mode = guard is not None and harvester.async_mode
+    if flag_mode:
+        guard.enable_harvest(
+            harvester.depth, int(state.step),
+            floor_fn=harvester.pending_floor,
+        )
+
+    def _train_emit(it, step_no):
+        def emit(vals):
+            _log_train(it, step_no, vals["cls_loss"], vals["mec_loss"])
+        return emit
+
+    def _chunk_emit(idxs, s0):
+        # idxs = [(row in the stacked metrics, global iter index)].
+        def emit(vals):
+            for jj, iter_no in idxs:
+                _log_train(
+                    iter_no, s0 + iter_no + 1,
+                    vals["cls_loss"][jj], vals["mec_loss"][jj],
+                )
+        return emit
+
     with contextlib.ExitStack() as _cleanup, PreemptionHandler(
         logger
     ) as preempt, HangWatchdog(
@@ -1741,6 +1893,7 @@ def run_officehome(
                 else None
             ),
             alerts=alert_engine,
+            harvester=harvester,
         )
 
         def _proactive_save(st):
@@ -1748,6 +1901,7 @@ def run_officehome(
             # run_digits._proactive_save.
             if not cfg.ckpt_dir:
                 return None
+            harvester.drain()  # checkpoint boundary: records before save
             step = int(st.step)
             with wd.suspended():
                 ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
@@ -1810,12 +1964,18 @@ def run_officehome(
                         state, metrics = inject.maybe_nan(
                             state, metrics, step0 + it + 1
                         )
+                        values = emit = None
                         if it % cfg.log_interval == 0:
-                            with obs.span("metric_host_fetch"):
-                                _log_train(
-                                    it, step0 + it + 1,
-                                    metrics["cls_loss"], metrics["mec_loss"],
-                                )
+                            values = {
+                                "cls_loss": metrics["cls_loss"],
+                                "mec_loss": metrics["mec_loss"],
+                            }
+                            emit = _train_emit(it, step0 + it + 1)
+                        harvester.put(
+                            step0 + it + 1, step0 + it + 1, values=values,
+                            flag=metrics["finite"] if flag_mode else None,
+                            emit=emit,
+                        )
                         state, stop = boundary(
                             state, metrics, 1, step0 + it + 1
                         )
@@ -1840,15 +2000,24 @@ def run_officehome(
                         state, ms = inject.maybe_nan(
                             st, ms, step0 + it + 1, step0 + it + n
                         )
-                        with obs.span("metric_host_fetch"):
-                            for j in range(n):
-                                if (it + j) % cfg.log_interval == 0:
-                                    _log_train(
-                                        it + j,
-                                        step0 + it + j + 1,
-                                        ms["cls_loss"][j],
-                                        ms["mec_loss"][j],
-                                    )
+                        # Stacked chunk metrics through the same ring —
+                        # see run_digits' chunked path.
+                        idxs = [
+                            (j, it + j) for j in range(n)
+                            if (it + j) % cfg.log_interval == 0
+                        ]
+                        values = emit = None
+                        if idxs:
+                            values = {
+                                "cls_loss": ms["cls_loss"],
+                                "mec_loss": ms["mec_loss"],
+                            }
+                            emit = _chunk_emit(idxs, step0)
+                        harvester.put(
+                            step0 + it + 1, step0 + it + n, values=values,
+                            flag=ms["finite"] if flag_mode else None,
+                            emit=emit,
+                        )
                         it += n
                         state, stop = boundary(state, ms, n, step0 + it)
                         # _boundary_actions evaluates/saves the live state
@@ -1867,6 +2036,10 @@ def run_officehome(
                         state, batches, raw_step, make_chunked, {}, on_steps,
                     )
             except RollbackRequest as rb:
+                # Drain pending harvest records first (generation-fenced
+                # — see run_digits rollback).
+                harvester.drain()
+                harvester.reset_stamps()  # numbering rewinds (run_digits)
                 # Non-raising rendezvous before restore (see run_digits
                 # rollback: a stale writer error must not abort recovery).
                 with wd.suspended():  # writer join blocks on in-flight I/O
@@ -1884,6 +2057,9 @@ def run_officehome(
                 seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
                 continue
             finally:
+                # Boundary drain (ISSUE-14) on EVERY exit, incl. the
+                # raising halt path — see run_digits' finally.
+                harvester.drain()
                 # Tear the pipeline down on EVERY exit (training done,
                 # rollback retry, preemption break, error) — prefetch
                 # close first (joins its producer thread, making the

@@ -122,9 +122,22 @@ def make_digits_train_step(
         # guard's finite-check input (and a free training-health metric) —
         # grads can go non-finite a step before the loss does.
         metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["finite"] = _finite_flag(metrics)
         return _apply_grads(state, tx, grads, stats), metrics
 
     return train_step
+
+
+def _finite_flag(metrics: Metrics) -> jax.Array:
+    """Device-side all-finite verdict over loss + grad norm — ONE bool
+    scalar computed inside the compiled step, so the harvested guard
+    (``--harvest_depth``, ISSUE-14) inspects a single host byte per step
+    instead of forcing the whole metrics tree.  Computed after the
+    cross-replica reductions, so it is replicated wherever the metrics
+    are."""
+    return jnp.isfinite(metrics["loss"]) & jnp.isfinite(
+        metrics["grad_norm"]
+    )
 
 
 def make_officehome_train_step(
@@ -167,6 +180,7 @@ def make_officehome_train_step(
         # See make_digits_train_step: the divergence guard's finite-check
         # input, computed on the already-reduced global gradients.
         metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["finite"] = _finite_flag(metrics)
         return _apply_grads(state, tx, grads, stats), metrics
 
     return train_step

@@ -300,6 +300,17 @@ class HeartbeatEmitter:
         dir_bytes = self._reg.value("dwt_ckpt_dir_bytes")
         if dir_bytes:
             values["ckpt_dir_bytes"] = int(dir_bytes)
+        # Metric-harvest feeds (ISSUE-14): ring occupancy + drain
+        # staleness, host-side integers the harvester's drain site
+        # already set — zero new syncs.  Absent when the run has no
+        # harvester (e.g. serving processes).
+        for name, key in (
+            ("dwt_harvest_ring_depth", "harvest_ring_depth"),
+            ("dwt_harvest_lag_steps", "harvest_lag_steps"),
+        ):
+            v = self._reg.value(name)
+            if v is not None:
+                values[key] = int(v)
         # flush (no fsync): the heartbeat is the liveness signal an
         # operator greps DURING a hang — buffered, the newest one would
         # sit in userspace through exactly that window (no later log()

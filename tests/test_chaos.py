@@ -169,6 +169,59 @@ def test_chaos_smoke_composed_faults_exit0_resumable(tmp_path):
     assert "resume" in _kinds(jsonl2)
 
 
+def test_chaos_nan_with_harvest_depth_detects_within_depth(tmp_path):
+    """ISSUE-14: nan_at_step composed with --harvest_depth 2.  The
+    harvested guard's verdict is a flag delivered at most ring-depth
+    dispatches late: the NaN at step 6 must be detected within 2 steps
+    (the divergence record stamps both the bad step and the boundary
+    that acted on it), the rollback must land a strictly pre-NaN
+    checkpoint, and the run must still complete."""
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        plan={"nan_at_step": 6},
+        extra=(
+            "--epochs", "3", "--harvest_depth", "2",
+            "--guard_policy", "rollback", "--guard_interval", "1",
+        ),
+    )
+    assert rc == 0, f"stderr tail: {stderr[-2000:]}"
+    recs = [json.loads(l) for l in open(jsonl).read().splitlines()]
+    div = [r for r in recs if r["kind"] == "divergence"]
+    assert div, "no divergence record"
+    assert div[0]["step"] == 6  # the verdict names the BAD step...
+    assert div[0]["detected_at"] - div[0]["step"] <= 2  # ...within depth
+    rb = [r for r in recs if r["kind"] == "rollback"]
+    assert rb and rb[0]["from_step"] == 6
+    assert rb[0]["step"] < 6  # pre-NaN restore target (epoch-1 ckpt)
+    assert _assert_resumable(ck) == 12  # trained to completion
+
+
+def test_chaos_sigterm_drain_loses_no_records(tmp_path):
+    """ISSUE-14: the preempt path drains the harvest ring inside the
+    grace window — the metric stream shows EVERY executed step exactly
+    once, in order, with its original stamp (nothing lost to in-flight
+    entries, nothing duplicated by the drain), alongside the exit-0
+    save-and-resume contract."""
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        plan={"sigterm_at_step": 6},
+        extra=("--epochs", "500", "--harvest_depth", "2"),
+    )
+    assert rc == 0, f"stderr tail: {stderr[-2000:]}"
+    recs = [json.loads(l) for l in open(jsonl).read().splitlines()]
+    train_steps = [r["step"] for r in recs if r["kind"] == "train"]
+    # log_interval 1: steps 1..6 ran before the boundary stop — each
+    # logged exactly once, in order, despite 2 being in flight when the
+    # SIGTERM's stop decision landed.
+    assert train_steps == [1, 2, 3, 4, 5, 6]
+    # The drain precedes the preempt narration on the stream.
+    kinds = [r["kind"] for r in recs]
+    assert kinds.index("preempt") > max(
+        i for i, k in enumerate(kinds) if k == "train"
+    )
+    assert _assert_resumable(ck) == 6
+
+
 # ------------------------------------------------------- full matrix (slow)
 
 

@@ -786,10 +786,18 @@ def test_guard_ladder_transient_nan_backs_off_and_recovers(tmp_path):
 
     ck = str(tmp_path / "ck")
     inject.arm(FaultPlan(nan_at_step=3))
+    # harvest_depth=0 pins the legacy synchronous guard check: the
+    # exact checkpoint-step arithmetic below depends on WHICH boundary
+    # detects the NaN, and under harvesting that is timing-dependent
+    # within the (bounded) ring staleness.  The harvested ladder is
+    # covered by tests/test_chaos.py::
+    # test_chaos_nan_with_harvest_depth_detects_within_depth and the
+    # staleness units in tests/test_harvest.py.
     acc = main(
         _digits_argv(
             tmp_path,
             epochs=3,
+            harvest_depth=0,
             guard_policy="rollback",
             guard_interval=1,
             guard_lr_backoff=0.5,
@@ -825,12 +833,16 @@ def test_guard_ladder_persistent_nan_escalates_in_order(tmp_path):
     from dwt_tpu.cli.usps_mnist import main
 
     ck = str(tmp_path / "ck")
-    # Steps 6,7,8 poisoned: 6 engages the backoff rung, 7 strikes while
-    # backed off (escalate: rollback to the epoch-1 checkpoint), 8 strikes
-    # during the still-backed-off replay (rollback budget of 1 is spent:
-    # halt).  Recovery is set far out so the scale cannot recover between
-    # strikes and blur the ladder order.
-    inject.arm(FaultPlan(nan_at_step=[6, 7, 8]))
+    # Steps 6,7,9 poisoned: 6 engages the backoff rung, 7 strikes while
+    # backed off (escalate: rollback to the epoch-1 checkpoint), 9
+    # strikes during the still-backed-off replay (rollback budget of 1
+    # is spent: halt).  Recovery is set far out so the scale cannot
+    # recover between strikes and blur the ladder order.  The third
+    # strike sits at 9 — not 8 — because the harvested guard (default
+    # --harvest_depth 2) acts on step 7's flag at the step-8 boundary,
+    # so step 8 (and a fault armed there) already ran before the
+    # rollback; a strike the replay can never reach proves nothing.
+    inject.arm(FaultPlan(nan_at_step=[6, 7, 9]))
     with pytest.raises(DivergenceError, match="rollbacks already spent"):
         main(
             _digits_argv(

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 _DIRECTION_RULES: List[Tuple[str, str]] = [
     (r"(imgs_per_s|imgs_per_sec|steps_per_s|per_sec)", "up"),
     (r"(accuracy|mfu)$", "up"),
+    (r"speedup", "up"),
     (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
     (r"(_ms|_s)(_p[0-9.]+)?$", "down"),
     (r"(ms_per_step|step_time|stall|latency|duration)", "down"),
@@ -109,6 +110,14 @@ def _extract_bench(rec: dict, out: Dict[str, float]) -> None:
         v = _num(rec.get(key))
         if v is not None:
             out[key] = v
+    # --harvest_depth sweep fields (harvest_d<N>_ms_per_step,
+    # harvest_record_speedup): the record-path A/B rides the same gate
+    # so the ISSUE-14 trajectory is enforced, not eyeballed.
+    for key, raw in rec.items():
+        if str(key).startswith("harvest_"):
+            v = _num(raw)
+            if v is not None:
+                out[str(key)] = v
 
 
 def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:

@@ -7,8 +7,9 @@ Reads the Chrome trace-event files the span tracer exports
 training/access metrics JSONL, and answers "where did the time go":
 
 * **per-step wall-time breakdown** — the train loop's top-level phases
-  (batch wait / step dispatch / metric host fetch / boundary / eval /
-  checkpoint enqueue) as *self-time* shares of the loop wall clock, with
+  (batch wait / step dispatch / metric copy start / harvest drain with
+  its nested blocking metric host fetch / boundary / eval / checkpoint
+  enqueue) as *self-time* shares of the loop wall clock, with
   an explicit ``unattributed`` residual so the table always accounts for
   100% of the wall time.  Self-time means a nested span's time is never
   double-counted into its parent: the rows sum exactly to the union of

@@ -28,6 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (  # noqa: E402
     _compile_with_flops,
     enable_compile_cache,
+    harvest_record_bench,
     scan_two_point,
     timing_label,
     two_point_per_step,
@@ -129,6 +130,13 @@ def main():
                          "amortizes the relay dispatch round-trip that "
                          "per-call timing cannot cancel — use on TPU for "
                          "chip-truth numbers (suggest 8)")
+    ap.add_argument("--harvest", default=None, metavar="D0,D1,...",
+                    help="sweep the RECORD path (dispatch + per-step "
+                         "metric handling through train/harvest.py) at "
+                         "each listed ring depth, e.g. '0,2' — the "
+                         "sync-vs-async A/B behind PERF.md 'Hot-path "
+                         "harvest'; shares bench.py's timing helper so "
+                         "the two tools' numbers stay comparable")
     args = ap.parse_args()
 
     out = {
@@ -174,6 +182,24 @@ def main():
     out["imgs_per_sec"] = round(3 * args.batch / per_step, 2)
     if total_flops:
         out["achieved_flops_per_sec"] = total_flops / per_step
+
+    if args.harvest:
+        # Record-path sweep: how much per-step wall the deferred metric
+        # pipeline buys back vs the legacy synchronous fetch (depth 0).
+        sweep = {}
+        hstate = state
+        for tok in str(args.harvest).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            d = int(tok)
+            per, hstate, hdeg = harvest_record_bench(
+                compiled, hstate, b, args.steps, d
+            )
+            sweep[str(d)] = round(per * 1e3, 3)
+            if hdeg:  # single-run average, not clean two-point
+                sweep[f"{d}_degraded"] = True
+        out["harvest_record_ms_per_step"] = sweep
 
     if args.ablate:
         # Same remat setting as the main step — otherwise the recompute
