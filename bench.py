@@ -684,6 +684,37 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
     return subprocess.call(cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
 
 
+def _maybe_compare(args, record) -> None:
+    """Route the printed record through the shared cross-run gate
+    (tools/obs_diff.py) when ``--compare`` names a baseline.  The record
+    line always prints FIRST and the gate's table goes to stderr —
+    stdout keeps the last-JSON-line-is-the-record contract — so the
+    measurement is never lost to a gate verdict.  Exits nonzero on
+    regression (3) / missing metric (4) / unusable baseline (2)."""
+    if not args.compare:
+        return
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"),
+    )
+    import obs_diff
+
+    try:
+        rc = obs_diff.gate(
+            args.compare, record,
+            default_tolerance_pct=args.compare_tolerance,
+            out=sys.stderr,
+        )
+    except (OSError, ValueError) as e:
+        # A typo'd/unreadable baseline must not turn a finished
+        # multi-minute measurement into a traceback: diagnose and exit
+        # with obs_diff's unusable-input code.
+        print(f"bench: --compare failed: {e}", file=sys.stderr)
+        sys.exit(2)
+    if rc != 0:
+        sys.exit(rc)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -711,10 +742,12 @@ def main():
     )
     ap.add_argument(
         "--phase",
-        choices=["train", "eval"],
+        choices=["train", "eval", "data"],
         default="train",
         help="train = fwd+bwd+update (the flagship metric); eval = the "
-        "inference test() path (target branch, running stats)",
+        "inference test() path (target branch, running stats); data = "
+        "the input pipeline (tools/data_bench.py: imgs/s vs workers + "
+        "seekable-sampler overhead — host-only, no device probe)",
     )
     ap.add_argument(
         "--harvest_depth",
@@ -758,11 +791,29 @@ def main():
     args = ap.parse_args()
     if args.pallas and args.model != "resnet50":
         ap.error("--pallas only applies to --model resnet50")
-    if args.pallas and args.phase == "eval":
+    if args.pallas and args.phase != "train":
         ap.error("--pallas is a training-path A/B; use --phase train")
-    if args.harvest_depth and args.phase == "eval":
+    if args.harvest_depth and args.phase != "train":
         ap.error("--harvest_depth sweeps the TRAIN record path; "
                  "use --phase train")
+
+    if args.phase == "data":
+        # Host-only arm: the input pipeline never touches the device, so
+        # no backend probe — this arm keeps measuring when the chip
+        # relay is down, and it rides the CPU-fallback re-exec verbatim.
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools"),
+        )
+        import data_bench
+
+        record = data_bench.run(items=max(512, 64 * args.steps))
+        if args.fallback_note:
+            record["fallback"] = args.fallback_note
+        print(json.dumps(record))
+        _maybe_compare(args, record)
+        return
 
     if not args.no_probe:
         # The subprocess jax probe is AUTHORITATIVE; the TCP port poll is
@@ -909,35 +960,7 @@ def main():
         _harvest_sweep(args, record)
     obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     print(json.dumps(record))
-    if args.compare:
-        # Route through the shared cross-run gate (tools/obs_diff.py):
-        # a bench run gates itself against a stored baseline in one
-        # command.  The record line above ALWAYS prints first, and the
-        # gate's table/summary go to STDERR — stdout keeps the repo's
-        # last-JSON-line-is-the-record contract (test_bench_contract
-        # consumers parse it that way), so the measurement is never
-        # lost to a gate verdict.
-        sys.path.insert(
-            0,
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tools"),
-        )
-        import obs_diff
-
-        try:
-            rc = obs_diff.gate(
-                args.compare, record,
-                default_tolerance_pct=args.compare_tolerance,
-                out=sys.stderr,
-            )
-        except (OSError, ValueError) as e:
-            # A typo'd/unreadable baseline must not turn a finished
-            # multi-minute measurement into a traceback: diagnose and
-            # exit with obs_diff's unusable-input code.
-            print(f"bench: --compare failed: {e}", file=sys.stderr)
-            sys.exit(2)
-        if rc != 0:
-            sys.exit(rc)
+    _maybe_compare(args, record)
 
 
 if __name__ == "__main__":

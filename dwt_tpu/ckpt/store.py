@@ -314,6 +314,7 @@ def stage_delta(
     delta_max_chain: int = DEFAULT_DELTA_MAX_CHAIN,
     require_finite: bool = True,
     write: bool = True,
+    data_state: Optional[dict] = None,
 ) -> Optional[dict]:
     """Write ``host_state``'s moved blobs + a staged manifest under
     ``.tmp-cas-<step>/``; returns the staged manifest, or None when
@@ -410,6 +411,11 @@ def stage_delta(
             "leaves": leaves,
             "bytes_written": written,
         }
+        if data_state is not None:
+            # The data plane's per-stream cursor snapshot: NOT chained —
+            # every manifest (full or delta) carries its own copy, so
+            # reading it never walks parents.
+            manifest["data_state"] = data_state
         mtmp = os.path.join(tmp, MANIFEST + ".tmp")
         with open(mtmp, "w") as f:
             json.dump(manifest, f, indent=1)
@@ -500,6 +506,7 @@ def save_delta(
     delta_max_chain: int = DEFAULT_DELTA_MAX_CHAIN,
     keep: Optional[int] = None,
     require_finite: bool = True,
+    data_state: Optional[dict] = None,
 ) -> Optional[str]:
     """Stage + promote in one call — the synchronous/single-process save
     path.  ``host_state`` is a host-side numpy pytree (``host_fetch``
@@ -520,7 +527,7 @@ def save_delta(
     staged = stage_delta(
         ckpt_dir, step, host_state, store_root=store_root,
         delta_max_chain=delta_max_chain, require_finite=require_finite,
-        write=primary,
+        write=primary, data_state=data_state,
     )
     path: Optional[str] = None
     if staged is not None and primary:

@@ -13,6 +13,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="dwt_tpu digits (DIAL/DWT) trainer")
     p.add_argument("--num_workers", type=int, default=d.num_workers,
                    help="item-loading worker threads (decode+augment)")
+    p.add_argument("--data_stall_timeout", type=float,
+                   default=d.data_stall_timeout,
+                   help="data-pipeline head-of-window stall budget "
+                        "(seconds): a worker silent past this is logged, "
+                        "counted (dwt_data_stalls_total), and its item "
+                        "speculatively re-submitted to a fresh worker — "
+                        "dead/slow-worker recovery instead of a silent "
+                        "stall.  0 disables detection")
     p.add_argument("--source_batch_size", type=int, default=d.source_batch_size)
     p.add_argument("--target_batch_size", type=int, default=d.target_batch_size)
     p.add_argument("--test_batch_size", type=int, default=d.test_batch_size)

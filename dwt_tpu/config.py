@@ -35,6 +35,12 @@ class DigitsConfig:
     lr_milestones: Tuple[int, ...] = (50, 80)  # epochs; MultiStepLR γ=0.1
     lr_gamma: float = 0.1
     num_workers: int = 2  # item-loading worker threads (reference :332)
+    # Data-pipeline head-of-window stall budget (data/pipeline.py): past
+    # this many seconds waiting on one item, the ordered-reassembly pool
+    # logs the stall, bumps dwt_data_stalls_total, and speculatively
+    # re-submits the item to a fresh worker (dead/slow-worker recovery).
+    # 0 disables detection (plain blocking waits).
+    data_stall_timeout: float = 60.0
     data_root: str = "../data"
     # dwt_tpu extensions
     synthetic: bool = False  # run on generated data (no dataset files)
@@ -181,6 +187,8 @@ class OfficeHomeConfig:
     log_interval: int = 10
     seed: int = 1
     num_workers: int = 2  # item-loading worker threads (reference :499)
+    # Data-pipeline stall budget — see DigitsConfig.data_stall_timeout.
+    data_stall_timeout: float = 60.0
     stat_collection_passes: int = 10  # eval_pass_collect_stats (:384)
     # dwt_tpu extensions
     arch: str = "resnet50"  # or "resnet101" (VisDA config)

@@ -43,6 +43,16 @@ from dwt_tpu.data.loader import (
     infinite,
     prefetch_to_device,
 )
+from dwt_tpu.data.sampler import (
+    SeekableSampler,
+    epoch_batch_count,
+)
+from dwt_tpu.data.pipeline import (
+    DATA_STATE_VERSION,
+    DataPlane,
+    OrderedWorkerPool,
+    StreamPos,
+)
 
 __all__ = [
     "ArrayDataset",
@@ -66,4 +76,10 @@ __all__ = [
     "batch_iterator",
     "infinite",
     "prefetch_to_device",
+    "SeekableSampler",
+    "epoch_batch_count",
+    "DATA_STATE_VERSION",
+    "DataPlane",
+    "OrderedWorkerPool",
+    "StreamPos",
 ]

@@ -8,11 +8,22 @@ per-item work (decode + augment) runs on a thread pool
 GIL in the hot paths), plus a background prefetch thread:
 ``prefetch_to_device`` keeps ``size`` batches resident on device — the
 standard JAX double-buffering pattern.
+
+Checkpointable data plane: epoch ordering is delegated to
+``dwt_tpu.data.sampler.SeekableSampler`` (a seeded O(1)-seekable Feistel
+bijection over ``range(n)`` — position ``k`` of epoch ``e`` is
+computable without materializing the order), and the worker pool to
+``dwt_tpu.data.pipeline.OrderedWorkerPool`` (bounded ordered-reassembly
+window with dead/slow-worker stall detection and live metrics).
+``start_batch`` opens an epoch at an exact batch cursor — the primitive
+mid-epoch resume is built on — and ``substitute=True`` (the train
+loops' setting) replaces quarantined items instead of dropping them, so
+per-epoch batch counts are FIXED and stream positions stay pure
+functions of the global step.
 """
 
 from __future__ import annotations
 
-import collections
 import json
 import logging
 import os
@@ -22,6 +33,7 @@ from typing import Callable, Dict, FrozenSet, Iterable, Iterator, Optional, Tupl
 
 import numpy as np
 
+from dwt_tpu.data.sampler import SeekableSampler
 from dwt_tpu.data.transforms import set_item_seed
 
 log = logging.getLogger(__name__)
@@ -194,40 +206,34 @@ def _pooled_items(dataset, indices, num_workers: int, token_of,
                   quarantine: bool = True,
                   known_bad: FrozenSet[int] = frozenset(),
                   on_quarantine: Optional[Callable[[int], None]] = None,
+                  stall_timeout: Optional[float] = None,
                   ) -> Iterator:
     """Map ``dataset[i]`` over ``indices`` on a thread pool, in order.
 
     The TPU-native stand-in for DataLoader worker *processes*: PIL decode,
     cv2 warps, and numpy arithmetic all drop the GIL, so threads give real
     parallel decode+augment without pickling datasets across processes.
-    A bounded in-flight window keeps memory proportional to the pool, and
-    a worker exception surfaces at the failing item's position in order.
+    Since the checkpointable data plane the pool itself lives in
+    ``dwt_tpu.data.pipeline.OrderedWorkerPool`` — bounded in-flight
+    window, ordered reassembly, dead/slow-worker stall detection with a
+    speculative respawn, and the live gauges/histogram — this wrapper
+    only binds the item-load closure (seed token + retry/quarantine
+    semantics, unchanged).
     """
-    from concurrent.futures import ThreadPoolExecutor
+    from dwt_tpu.data.pipeline import DEFAULT_STALL_TIMEOUT_S, OrderedWorkerPool
 
-    window = max(2 * num_workers, 8)
-    it = iter(indices)
-    ex = ThreadPoolExecutor(
-        max_workers=num_workers, thread_name_prefix="dwt-data"
+    pool = OrderedWorkerPool(
+        num_workers,
+        stall_timeout=(
+            DEFAULT_STALL_TIMEOUT_S if stall_timeout is None
+            else stall_timeout
+        ),
     )
-    try:
-        pending: "collections.deque" = collections.deque()
-        for i in it:
-            pending.append(ex.submit(_load_item, dataset, i, token_of(i),
-                                     retries, quarantine, known_bad,
-                                     on_quarantine))
-            if len(pending) >= window:
-                break
-        while pending:
-            item = pending.popleft().result()
-            for i in it:  # top the window back up
-                pending.append(ex.submit(_load_item, dataset, i, token_of(i),
-                                     retries, quarantine, known_bad,
-                                     on_quarantine))
-                break
-            yield item
-    finally:
-        ex.shutdown(wait=False, cancel_futures=True)
+    return pool.imap(
+        lambda i: _load_item(dataset, i, token_of(i), retries, quarantine,
+                             known_bad, on_quarantine),
+        indices,
+    )
 
 
 def batch_iterator(
@@ -244,6 +250,11 @@ def batch_iterator(
     quarantine_registry: Optional[QuarantineRegistry] = None,
     quarantine_key: str = "items",
     pad_and_mask: bool = False,
+    start_batch: int = 0,
+    substitute: bool = False,
+    on_batch_ids: Optional[Callable] = None,
+    on_substitute: Optional[Callable[[], None]] = None,
+    stall_timeout: Optional[float] = None,
 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield tuples of stacked numpy batches from an indexable dataset.
 
@@ -287,12 +298,32 @@ def batch_iterator(
       once.  Requires ``shuffle=False, drop_last=False`` (evaluation
       semantics; padding a shuffled training epoch would be a bug).  A
       quarantined item is substituted and masked out — the masked count
-      excludes it, matching the unsharded drop semantics.
+      excludes it, matching the unsharded drop semantics;
+    * ``start_batch=k`` (mid-epoch resume): open the epoch at batch
+      cursor ``k`` of THIS process's sequence — the skipped prefix is
+      never index-generated or loaded (the seekable sampler maps only
+      the remaining positions), so a resume is O(remaining), and the
+      yielded batches are bitwise the suffix an uninterrupted epoch
+      would have produced.  Train-path only (``pad_and_mask`` refuses
+      it: the mask arithmetic assumes position 0);
+    * ``substitute=True`` (the train loops since the checkpointable data
+      plane): quarantined items are REPLACED by the nearest good item on
+      every path, not just under ``shard`` — per-epoch batch counts stay
+      FIXED, which is what makes stream positions pure functions of the
+      global step and mid-epoch seek exact.  ``on_substitute`` is called
+      once per substituted sample (the DataState's
+      quarantine-substitution count);
+    * ``on_batch_ids``: called with the dataset indices of every yielded
+      batch (post-substitution) — the batch-id trail hook the exact-
+      resume chaos proofs diff;
+    * ``stall_timeout``: head-of-window stall budget for the worker pool
+      (``pipeline.OrderedWorkerPool``); None keeps the pool default.
     """
     n = len(dataset)
-    order = np.arange(n)
-    if shuffle:
-        order = np.random.default_rng((seed, epoch)).permutation(n)
+    sampler = SeekableSampler(n, seed=seed, epoch=epoch, shuffle=shuffle)
+    start_batch = int(start_batch)
+    if start_batch < 0:
+        raise ValueError(f"start_batch must be >= 0; got {start_batch}")
     mask = None
     if pad_and_mask:
         if shuffle or drop_last:
@@ -300,6 +331,12 @@ def batch_iterator(
                 "pad_and_mask is an eval-path contract: it requires "
                 "shuffle=False and drop_last=False"
             )
+        if start_batch:
+            raise ValueError(
+                "start_batch is a train-path resume cursor; the "
+                "pad_and_mask eval contract always starts at 0"
+            )
+        order = sampler.positions()
         span = batch_size * (shard[1] if shard is not None else 1)
         target = ((n + span - 1) // span) * span
         mask = np.ones(target, bool)
@@ -307,16 +344,35 @@ def batch_iterator(
             mask[n:] = False
             pad_src = order[-1:] if n else np.zeros(1, order.dtype)
             order = np.concatenate([order, np.repeat(pad_src, target - n)])
-    if shard is not None:
-        index, count = shard
-        if drop_last:
-            usable = n - n % (count * batch_size)
-            order = order[:usable]
-        order = order[index::count]
-        if mask is not None:
-            mask = mask[index::count]
-    stop = len(order) - (len(order) % batch_size if drop_last else 0)
-    indices = order[:stop]
+        if shard is not None:
+            order = order[shard[0]::shard[1]]
+            mask = mask[shard[0]::shard[1]]
+        stop = len(order) - (len(order) % batch_size if drop_last else 0)
+        indices = order[:stop]
+        prior_positions = None
+    else:
+        # Train path: pure position arithmetic, then ONE seekable map of
+        # exactly the remaining positions — a start_batch seek never
+        # generates (or loads) the skipped prefix.
+        index, count = shard if shard is not None else (0, 1)
+        usable = n - n % (count * batch_size) if drop_last else n
+        per_process = (usable - index + count - 1) // count if usable > index else 0
+        stop = per_process - (per_process % batch_size if drop_last else 0)
+        first = start_batch * batch_size
+        positions = np.arange(
+            index + count * first, index + count * stop, count,
+            dtype=np.int64,
+        )
+        indices = sampler.take(positions)
+        # This process's element positions BEFORE the resume cursor,
+        # newest first: the substitution seed walk below needs them so a
+        # quarantined item at the cursor substitutes the SAME nearest-
+        # preceding good item the uninterrupted epoch used.
+        prior_positions = (
+            np.arange(index, index + count * first, count,
+                      dtype=np.int64)[::-1]
+            if first else None
+        )
     token_of = lambda i: (seed, epoch, int(i))
     known_bad: FrozenSet[int] = frozenset()
     on_quarantine = None
@@ -326,7 +382,7 @@ def batch_iterator(
     if num_workers and num_workers > 1:
         items_iter = _pooled_items(
             dataset, indices, num_workers, token_of, item_retries,
-            quarantine, known_bad, on_quarantine,
+            quarantine, known_bad, on_quarantine, stall_timeout,
         )
     else:
         items_iter = (
@@ -337,30 +393,73 @@ def batch_iterator(
 
     masked = mask is not None
 
-    def _emit(batch, bits):
+    def _emit(batch, bits, ids):
         fields = tuple(
             _stack([item[f] for item in batch]) for f in range(len(batch[0]))
         )
         if masked:
             fields += (np.asarray(bits, bool),)
+        if on_batch_ids is not None:
+            on_batch_ids(list(ids))
         return fields
 
-    batch, bits = [], []
+    def _note_sub():
+        if on_substitute is not None:
+            on_substitute()
+
+    prefix_walked = False
+
+    def _seed_from_prefix():
+        """Nearest preceding good item BEFORE the resume cursor.
+
+        A quarantined item substitutes the nearest preceding good item;
+        an iterator opened at ``start_batch > 0`` has not loaded that
+        prefix, so a bad item AT the cursor would otherwise fall into
+        the deficit path and repay with the FOLLOWING item — a different
+        batch than the uninterrupted epoch produced, silently breaking
+        the exact-resume byte-identity contract.  Walking the cursor's
+        prefix backward (O(1) per position via the seekable sampler,
+        item loads only until the first good one) reproduces the golden
+        run's substitute; a fully-bad prefix returns None, which is
+        exactly the golden run's own deficit case.
+        """
+        nonlocal prefix_walked
+        prefix_walked = True
+        if prior_positions is None:
+            return None
+        for p in prior_positions:
+            i = int(sampler.take([int(p)])[0])
+            item = _load_item(dataset, i, token_of(i), item_retries,
+                              quarantine, known_bad, on_quarantine)
+            if item is not QUARANTINED:
+                return item, i
+        return None
+
+    batch, bits, ids = [], [], []
     last_good = None
+    last_good_id = None
     deficit = 0  # quarantined items seen before the first good one
     for pos, item in enumerate(items_iter):
+        item_id = int(indices[pos])
         bit = bool(mask[pos]) if masked else True
         if item is QUARANTINED:
-            if shard is None and not masked:
+            if shard is None and not masked and not substitute:
                 continue
-            # Sharded/masked: substitute instead of dropping (see
-            # docstring); the masked slot counts as absent either way.
+            # Sharded/masked/substitute: replace instead of dropping (see
+            # docstring); a masked slot counts as absent either way, an
+            # unmasked one counts as a substitution.
             if masked:
                 bit = False
+            if last_good is None and not prefix_walked:
+                seeded = _seed_from_prefix()
+                if seeded is not None:
+                    last_good, last_good_id = seeded
             if last_good is None:
                 deficit += 1
                 continue
-            item = last_good
+            item, item_id = last_good, last_good_id
+            if not masked:
+                _note_sub()
         else:
             if deficit:
                 # Repay leading quarantined slots now that a good item
@@ -369,18 +468,22 @@ def batch_iterator(
                 for _ in range(deficit):
                     batch.append(item)
                     bits.append(not masked)
+                    ids.append(int(indices[pos]))
+                    if not masked:
+                        _note_sub()
                     if len(batch) == batch_size:
-                        yield _emit(batch, bits)
-                        batch, bits = [], []
+                        yield _emit(batch, bits, ids)
+                        batch, bits, ids = [], [], []
                 deficit = 0
-            last_good = item
+            last_good, last_good_id = item, item_id
         batch.append(item)
         bits.append(bit)
+        ids.append(item_id)
         if len(batch) == batch_size:
-            yield _emit(batch, bits)
-            batch, bits = [], []
+            yield _emit(batch, bits, ids)
+            batch, bits, ids = [], [], []
     if batch and not drop_last:  # trailing partial batch
-        yield _emit(batch, bits)
+        yield _emit(batch, bits, ids)
 
 
 def infinite(

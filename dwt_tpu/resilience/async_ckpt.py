@@ -293,6 +293,7 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         return save_host_shard(
             ckpt_dir, step, host_tree, self.process_index,
             require_finite=kwargs.get("require_finite", True),
+            data_state=kwargs.get("data_state"),
         )
 
     def _promote(self, ckpt_dir: str, step: int, kwargs: dict) -> str:
@@ -467,6 +468,7 @@ class MultiHostDeltaAsyncCheckpointer(MultiHostAsyncCheckpointer):
             ),
             require_finite=kwargs.get("require_finite", True),
             write=self.process_index == 0,
+            data_state=kwargs.get("data_state"),
         )
         return staged is not None
 

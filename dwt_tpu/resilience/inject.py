@@ -50,7 +50,13 @@ production code at exactly the points the real fault would strike:
   :class:`FlakyDataset` when the plan condemns items for that role,
   driving the loader's retry/quarantine path from a subprocess.
 * :class:`FlakyDataset` — the in-process form: chosen indices raise for
-  the first N accesses (transient I/O) or always (corrupt item).
+  the first N accesses (transient I/O) or always (corrupt item), hang
+  forever on their first access (``dead_worker_at`` — the pool worker
+  holding the item is lost, exactly like a thread wedged in a dead
+  filesystem read; the pipeline's stall detection must respawn the
+  item, not the epoch), or stall their first access for
+  ``slow_item_s`` seconds (``slow_item_at`` — a per-item decode stall
+  the ordered-reassembly window must absorb without reordering).
 
 All hooks are no-ops (one ``is None`` check) unless a plan is armed, so
 the production hot paths pay nothing.  Arm programmatically with
@@ -129,6 +135,17 @@ class FaultPlan:
     # {"source": [idx, ...], "target": [...]} — items the loops' datasets
     # report as corrupt (the loader quarantines them).
     corrupt_items: Optional[Dict[str, List[int]]] = None
+    # {"source": [idx, ...]} — the pool worker loading that item hangs
+    # forever on its FIRST access (a dead/wedged worker mid-epoch); the
+    # data pipeline's head-of-window stall detection must log, count,
+    # and respawn the item on a fresh worker.  Subsequent accesses (the
+    # respawned attempt) succeed.
+    dead_worker_at: Optional[Dict[str, List[int]]] = None
+    # {"source": [idx, ...]} — that item's FIRST decode stalls for
+    # slow_item_s seconds, then succeeds (a transiently slow item the
+    # ordered window must absorb in order).
+    slow_item_at: Optional[Dict[str, List[int]]] = None
+    slow_item_s: float = 1.0
     # Step boundary at which a preemption NOTICE becomes visible on this
     # host (stands in for the GCE metadata warning / a scheduler notice
     # file): the loops take an all-host proactive save and keep training.
@@ -154,7 +171,8 @@ class FaultPlan:
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
         "slow_step_s", "sigterm_at_step", "io_error_saves", "corrupt_items",
         "notice_at_step", "kill_writer_mid_shard", "kill_mid_delta_promote",
-        "missing_parent_blob",
+        "missing_parent_blob", "dead_worker_at", "slow_item_at",
+        "slow_item_s",
     )
 
     @classmethod
@@ -250,27 +268,48 @@ class FaultPlan:
         kill_writer = _true_or_step("kill_writer_mid_shard")
         kill_promote = _true_or_step("kill_mid_delta_promote")
         missing_blob = _opt_int("missing_parent_blob")
-        corrupt = spec.get("corrupt_items")
-        if corrupt is not None:
-            if not isinstance(corrupt, dict):
+
+        def _role_items(field):
+            """Validate a role→item-index map (corrupt_items and the
+            data-pipeline fault kinds share the shape and rules)."""
+            value = spec.get(field)
+            if value is None:
+                return None
+            if not isinstance(value, dict):
                 raise ValueError(
-                    f"{ENV_VAR}: corrupt_items must map a stream role to a "
-                    f"list of item indices; got {corrupt!r}"
+                    f"{ENV_VAR}: {field} must map a stream role to a "
+                    f"list of item indices; got {value!r}"
                 )
             normalized = {}
-            for role, ids in corrupt.items():
+            for role, ids in value.items():
                 if role not in ("source", "target"):
                     raise ValueError(
-                        f"{ENV_VAR}: corrupt_items role must be 'source' or "
+                        f"{ENV_VAR}: {field} role must be 'source' or "
                         f"'target'; got {role!r}"
                     )
                 # Keep the NORMALIZED list: a scalar spec must arm, not
                 # crash (or silently no-op) at wrap_dataset.  Item
                 # indices are 0-based (unlike steps).
                 normalized[role] = _as_step_list(
-                    ids, f"corrupt_items[{role!r}]", minimum=0
+                    ids, f"{field}[{role!r}]", minimum=0
                 )
-            corrupt = normalized
+            return normalized
+
+        corrupt = _role_items("corrupt_items")
+        dead_worker = _role_items("dead_worker_at")
+        slow_item = _role_items("slow_item_at")
+        slow_item_s = spec.get("slow_item_s", 1.0)
+        if isinstance(slow_item_s, bool) or not isinstance(
+                slow_item_s, (int, float)) or slow_item_s < 0:
+            raise ValueError(
+                f"{ENV_VAR}: slow_item_s must be a non-negative number; "
+                f"got {slow_item_s!r}"
+            )
+        if "slow_item_s" in spec and slow_item is None:
+            raise ValueError(
+                f"{ENV_VAR}: slow_item_s without slow_item_at arms "
+                "nothing — name the item the stall should hit"
+            )
         return cls(
             nan_at_step=nan,
             crash_in_save=crash,
@@ -284,6 +323,9 @@ class FaultPlan:
             kill_writer_mid_shard=kill_writer,
             kill_mid_delta_promote=kill_promote,
             missing_parent_blob=missing_blob,
+            dead_worker_at=dead_worker,
+            slow_item_at=slow_item,
+            slow_item_s=float(slow_item_s),
         )
 
     @classmethod
@@ -511,34 +553,64 @@ def maybe_missing_parent_blob(step: int, inherited_blobs: Any) -> None:
 
 def wrap_dataset(dataset: Any, role: str) -> Any:
     """Wrap ``dataset`` in :class:`FlakyDataset` when the plan condemns
-    items for ``role`` ('source'/'target'); pass-through otherwise."""
+    items for ``role`` ('source'/'target') under ANY of the item-level
+    kinds (corrupt, dead-worker hang, slow decode); pass-through
+    otherwise.  The kinds compose on one wrapper — a plan may corrupt
+    item 3 and hang the worker on item 7 of the same stream."""
     plan = current()
-    if plan is None or not plan.corrupt_items:
+    if plan is None:
         return dataset
-    ids = plan.corrupt_items.get(role)
-    if isinstance(ids, int):  # programmatic arm() may pass a bare index
-        ids = [ids]
-    if not ids:
+
+    def _ids(table):
+        if not table:
+            return ()
+        ids = table.get(role)
+        if isinstance(ids, int):  # programmatic arm() may pass a bare index
+            ids = [ids]
+        return tuple(int(i) for i in ids or ())
+
+    corrupt = _ids(plan.corrupt_items)
+    hang = _ids(plan.dead_worker_at)
+    slow = _ids(plan.slow_item_at)
+    if not (corrupt or hang or slow):
         return dataset
-    return FlakyDataset(dataset, corrupt=tuple(int(i) for i in ids))
+    return FlakyDataset(
+        dataset, corrupt=corrupt, hang=hang, slow=slow,
+        slow_s=plan.slow_item_s,
+    )
 
 
 class FlakyDataset:
-    """Dataset wrapper whose chosen indices raise on access.
+    """Dataset wrapper whose chosen indices misbehave on access.
 
     ``fail={idx: n}`` — index ``idx`` raises :class:`OSError` for its
     first ``n`` accesses, then succeeds (transient I/O; exercises retry).
     ``corrupt=(idx, ...)`` — those indices always raise (undecodable item;
-    exercises quarantine).  Deterministic: failures depend only on the
-    access count per index.
+    exercises quarantine).
+    ``hang=(idx, ...)`` — the FIRST access blocks forever (the worker
+    thread is lost, a dead worker; exercises the pipeline's stall
+    detection + respawn — the respawned second access succeeds).
+    ``slow=(idx, ...)`` — the first access sleeps ``slow_s`` then
+    succeeds (a per-item decode stall; exercises ordered reassembly).
+    Deterministic: behavior depends only on the access count per index.
+    Access counting is lock-guarded — these hooks fire on concurrent
+    pool workers, and a double-counted first access would silently skip
+    the armed fault.
     """
 
     def __init__(self, base, fail: Optional[Dict[int, int]] = None,
-                 corrupt: Tuple[int, ...] = ()):
+                 corrupt: Tuple[int, ...] = (), hang: Tuple[int, ...] = (),
+                 slow: Tuple[int, ...] = (), slow_s: float = 1.0):
+        import threading
+
         self.base = base
         self.fail = dict(fail or {})
         self.corrupt = frozenset(corrupt)
+        self.hang = frozenset(hang)
+        self.slow = frozenset(slow)
+        self.slow_s = float(slow_s)
         self._counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.base)
@@ -547,8 +619,15 @@ class FlakyDataset:
         i = int(i)
         if i in self.corrupt:
             raise OSError(f"injected corrupt item {i}")
-        seen = self._counts.get(i, 0)
-        self._counts[i] = seen + 1
+        with self._lock:
+            seen = self._counts.get(i, 0)
+            self._counts[i] = seen + 1
         if seen < self.fail.get(i, 0):
             raise OSError(f"injected transient failure {i} (attempt {seen + 1})")
+        if seen == 0 and i in self.hang:
+            import threading
+
+            threading.Event().wait()  # a dead worker never comes back
+        if seen == 0 and i in self.slow:
+            time.sleep(self.slow_s)
         return self.base[i]

@@ -33,6 +33,7 @@ from dwt_tpu.config import DigitsConfig, OfficeHomeConfig
 from dwt_tpu.data import (
     ArrayDataset,
     Compose,
+    DataPlane,
     FusedAffineBlurNormalize,
     FusedToArrayNormalize,
     ImageFolderDataset,
@@ -42,8 +43,8 @@ from dwt_tpu.data import (
     Resize,
     ThreadLocalRng,
     batch_iterator,
+    epoch_batch_count,
     gaussian_blur,
-    infinite,
     load_mnist,
     load_usps,
     prefetch_to_device,
@@ -90,6 +91,7 @@ from dwt_tpu.utils import (
     MetricLogger,
     anchor_dir,
     is_valid_checkpoint,
+    load_data_state,
     percentile_summary,
     ranked_checkpoints,
     restore_newest,
@@ -973,15 +975,75 @@ _ranked_checkpoints = ranked_checkpoints
 _restore_newest = restore_newest
 
 
+def _seek_data_plane(
+    plane: Optional[DataPlane], *, ckpt_dir, source: str,
+    step: int, fallback_epoch: int, exact_step: Optional[int] = None,
+    arith_ok: bool = True,
+) -> str:
+    """Re-open position for the data plane after a restore (startup
+    resume or guard rollback); returns the mode logged on the record.
+
+    * ``exact`` — the restored checkpoint carried a usable ``data_state``:
+      every stream seeks to its recorded (epoch, batch-cursor) and the
+      remaining batch-id sequence is bitwise what an uninterrupted run
+      would have produced;
+    * ``exact_arith`` — an in-memory guard snapshot (``source ==
+      'memory'``): no manifest, but substitution semantics make
+      positions pure functions of the step, so the seek is arithmetic
+      and still exact — PROVIDED the run is step-aligned
+      (``arith_ok``): an epoch-boundary-downgraded resume or an earlier
+      in-memory guard recovery (data runs ahead while ``state.step``
+      rewinds) breaks position == divmod(step), and a silently wrong
+      "exact" seek is worse than the honest fallback;
+    * ``epoch_boundary`` — a checkpoint without ``data_state`` (old
+      format), a mismatched one (geometry changed), or a memory restore
+      in a non-step-aligned run: today's epoch-granular fallback,
+      logged as a downgrade.
+    """
+    if plane is None:
+        return "none"
+    if source == "memory":
+        if exact_step is not None and arith_ok:
+            plane.seek_step(exact_step)
+            return "exact_arith"
+        plane.seek_epoch(fallback_epoch)
+        log.warning(
+            "in-memory rollback in a non-step-aligned run (downgraded "
+            "resume or prior in-memory recovery): resuming the data "
+            "streams at the epoch boundary, not an arithmetic cursor "
+            "that would silently be wrong"
+        )
+        return "epoch_boundary"
+    recorded = None
+    if ckpt_dir and source in ("checkpoint", "anchor"):
+        step_dir = os.path.join(
+            ckpt_dir if source == "checkpoint" else anchor_dir(ckpt_dir),
+            str(int(step)),
+        )
+        recorded = load_data_state(step_dir)
+    if plane.load_snapshot(recorded):
+        return "exact"
+    plane.seek_epoch(fallback_epoch)
+    log.warning(
+        "checkpoint step %d has no usable data_state (%s): resuming the "
+        "data streams at the epoch boundary — the within-epoch position "
+        "is lost, exactly the pre-data-plane behavior", step,
+        "data_state: null" if recorded is None else "mismatched",
+    )
+    return "epoch_boundary"
+
+
 def _rollback_state(
     cfg, logger, guard: DivergenceGuard, template, failed_step, coord=None,
     plan=None,
 ):
     """Recovery state for a ``rollback`` policy hit: the newest valid
     on-disk checkpoint (anchors included), else the guard's last
-    in-memory good state.  Callers flush the async checkpoint pipeline
-    BEFORE calling, so the in-flight save is on disk and the writer
-    cannot race this directory walk.
+    in-memory good state.  Returns ``(state, source)`` so the caller can
+    re-seek the data plane (exact from the winning artifact's
+    data_state; arithmetic for a memory snapshot).  Callers flush the
+    async checkpoint pipeline BEFORE calling, so the in-flight save is
+    on disk and the writer cannot race this directory walk.
 
     Multi-host: hosts first agree on the restore target — the min over
     each host's newest valid step (the newest step EVERY host can see;
@@ -1030,7 +1092,7 @@ def _rollback_state(
         rollbacks=guard.rollbacks,
         sync=True,
     )
-    return restored
+    return restored, source
 
 
 def _best_record_path(ckpt_dir: str) -> str:
@@ -1151,6 +1213,24 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     if steps_per_epoch == 0:
         raise ValueError("datasets smaller than one batch")
 
+    # Checkpointable data plane (ISSUE-15): one authority over both
+    # streams' seed lineage and (epoch, batch-cursor) position.  The
+    # zipped iteration consumes one batch per stream per step, so both
+    # streams roll at the zip length (steps_per_epoch), and quarantine
+    # SUBSTITUTION keeps that length fixed — positions stay pure
+    # functions of the global step, which is what makes mid-epoch seek
+    # exact.  Its snapshot travels inside every checkpoint manifest.
+    qreg = (
+        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
+    )
+    plane = DataPlane(
+        shard=shard, num_workers=cfg.num_workers,
+        stall_timeout=getattr(cfg, "data_stall_timeout", 60.0),
+        quarantine_registry=qreg,
+    )
+    plane.register("source", seed=cfg.seed, epoch_len=steps_per_epoch)
+    plane.register("target", seed=cfg.seed + 1, epoch_len=steps_per_epoch)
+
     # Pre-step MultiStepLR over epochs → step-count boundaries at
     # (milestone-1)*steps_per_epoch (SURVEY §7 scheduler quirk).
     schedule = multistep_schedule(
@@ -1200,8 +1280,20 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 "(main or anchors)"
             )
         state, src = resumed
-        start_epoch = int(state.step) // steps_per_epoch
-        logger.log("resume", int(state.step), epoch=start_epoch, source=src)
+        # Exact mid-epoch resume: the checkpoint's data_state re-opens
+        # both streams at the recorded (epoch, batch-cursor); an old
+        # checkpoint (data_state: null) falls back to the epoch
+        # boundary, logged.
+        data_mode = _seek_data_plane(
+            plane, ckpt_dir=cfg.ckpt_dir, source=src,
+            step=int(state.step),
+            fallback_epoch=int(state.step) // steps_per_epoch,
+        )
+        start_epoch = plane.streams["source"].epoch
+        logger.log(
+            "resume", int(state.step), epoch=start_epoch, source=src,
+            data=data_mode, cursor=plane.streams["source"].cursor,
+        )
     # Fresh-init (or dp-restored) state onto the plan's placement; a
     # no-op except under a model-sharded plan (single/replica keep
     # today's uncommitted-leaf flow bitwise).
@@ -1239,12 +1331,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
         guard.prime(state)
     coord = Coordinator()  # multi-host consensus; single-process: inert
     ckpt = _CkptPipeline(cfg, coord, plan)
-    qreg = (
-        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
-    )
     acc = 0.0
     epoch = start_epoch
-    seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
+    # Rollback re-seed base: a resumed run continues the RECORDED bump
+    # lineage (a crash after k rollbacks must not fold the shuffle
+    # streams back onto orders that already diverged).
+    bump0 = plane.seed_bump
+    # Step-aligned: stream position == divmod(state.step).  False after
+    # an epoch-boundary-downgraded resume; a later in-memory guard
+    # recovery breaks it too (checked via guard.recoveries at use).
+    step_aligned = not ranked_resume or data_mode != "epoch_boundary"
     gstep = int(state.step)  # host-side global step count (guard/injection)
     # Async metric harvesting (ISSUE-14): every hot-path record/verdict
     # rides the bounded ring; with an active guard the divergence
@@ -1330,23 +1426,19 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             harvester.drain()  # checkpoint boundary: records before save
             step = int(st.step)
             with wd.suspended():  # save may legitimately outlast the timeout
-                ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
+                ckpt.save(cfg.ckpt_dir, step, st,
+                          data_state=plane.snapshot(), **_keep_kwargs(cfg))
             logger.log("notice_save", step, epoch=epoch, sync=True)
             return step
 
         boundary.on_notice = _proactive_save
         while epoch < cfg.epochs:
-            source_iter = batch_iterator(
-                source_ds, local_bs, shuffle=True, seed=cfg.seed + seed_bump,
-                epoch=epoch, shard=shard, num_workers=cfg.num_workers,
-                quarantine_registry=qreg, quarantine_key="source",
-            )
-            target_iter = batch_iterator(
-                target_ds, local_bs, shuffle=True,
-                seed=cfg.seed + 1 + seed_bump, epoch=epoch, shard=shard,
-                num_workers=cfg.num_workers,
-                quarantine_registry=qreg, quarantine_key="target",
-            )
+            # Streams open at the plane's CURRENT position: cursor > 0
+            # only on the first (resumed mid-epoch) pass; thereafter the
+            # per-step advances roll the plane to each epoch boundary in
+            # lockstep with this loop's own epoch counter.
+            source_iter = plane.epoch_iterator(source_ds, "source", local_bs)
+            target_iter = plane.epoch_iterator(target_ds, "target", local_bs)
 
             def epoch_batches():
                 for (sx, sy), (txi, _) in zip(source_iter, target_iter):
@@ -1381,6 +1473,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         with obs.span("step_dispatch"):
                             state, metrics = train_step(state, batch)
                         gstep += 1
+                        plane.advance(1)  # one batch per stream consumed
                         state, metrics = inject.maybe_nan(state, metrics, gstep)
                         values = emit = None
                         if i % cfg.log_interval == 0:
@@ -1413,6 +1506,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         nonlocal pos, gstep
                         lo = gstep + 1
                         gstep += n
+                        plane.advance(n)  # n batches per stream consumed
                         st, ms = inject.maybe_nan(st, ms, lo, gstep)
                         # The whole chunk's [n]-stacked metrics stream
                         # through the SAME ring as the per-step path —
@@ -1476,13 +1570,33 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                 # hang here forever with the watchdog blinded.  The
                 # timeout budgets a restore, exactly like the unmasked
                 # restore on the startup resume path.
-                state = _rollback_state(
+                state, rb_src = _rollback_state(
                     cfg, logger, guard, state, rb.step, coord, plan
                 )
                 wd.heartbeat()
                 gstep = int(state.step)
-                epoch = gstep // steps_per_epoch
-                seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                # Re-seek the data plane to the restored step's exact
+                # batch cursor (recorded data_state; arithmetic for a
+                # memory snapshot), THEN bump the seed lineage: the
+                # replayed segment trains on a fresh shuffle order from
+                # the same position — replaying the exact order that
+                # just diverged would be the one guaranteed-useless
+                # retry.
+                rb_mode = _seek_data_plane(
+                    plane, ckpt_dir=cfg.ckpt_dir, source=rb_src,
+                    step=gstep, fallback_epoch=gstep // steps_per_epoch,
+                    exact_step=gstep,
+                    arith_ok=step_aligned and guard.recoveries == 0,
+                )
+                if rb_mode == "epoch_boundary":
+                    # Streams now sit at an epoch boundary while gstep is
+                    # mid-epoch: position != divmod(step) from here on, so
+                    # a LATER memory rollback must not trust arithmetic.
+                    step_aligned = False
+                plane.seed_bump = (
+                    bump0 + guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                )
+                epoch = plane.streams["source"].epoch
                 continue
             finally:
                 # Boundary drain (ISSUE-14) on EVERY exit — normal epoch
@@ -1534,6 +1648,7 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
                         if resume_step is None:
                             ckpt.save(
                                 cfg.ckpt_dir, int(state.step), state,
+                                data_state=plane.snapshot(),
                                 **_keep_kwargs(cfg),
                             )
                         # else: the proactive save is durable — the
@@ -1557,15 +1672,16 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
             _note_accuracy(acc)
             logger.log("test", int(state.step), epoch=epoch, **result)
             targets = []
+            data_kw = {"data_state": plane.snapshot()}
             if cfg.ckpt_dir and (
                 (epoch + 1) % cfg.ckpt_every_epochs == 0
                 or epoch == cfg.epochs - 1
             ):
-                targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
+                targets.append((cfg.ckpt_dir, {**_keep_kwargs(cfg), **data_kw}))
             if cfg.ckpt_dir and cfg.anchor_every and (
                 (epoch + 1) % cfg.anchor_every == 0
             ):
-                targets.append((_anchor_dir(cfg.ckpt_dir), {}))
+                targets.append((_anchor_dir(cfg.ckpt_dir), dict(data_kw)))
             if targets:
                 # A synchronous save (--no-async_ckpt, or the multi-host
                 # downgrade) can legitimately block past the watchdog
@@ -1661,6 +1777,43 @@ def run_officehome(
     bs = cfg.source_batch_size  # target loader uses source bs too (:565)
     local_bs, shard = _multihost_data_split(cfg, bs)
 
+    # Checkpointable data plane (ISSUE-15): the two infinite streams
+    # roll epochs independently (source and target datasets differ in
+    # size), each at its FIXED per-process batch count — quarantine
+    # substitution keeps the counts fixed, so positions are pure
+    # functions of the iteration count and mid-epoch seek is exact.
+    # The target-augmented view is an alias: it rides the target
+    # iterator (the dual-view triple protocol), so its DataState entry
+    # seeks with the target's cursor and its transforms re-derive from
+    # the same (seed, epoch, index) tokens.
+    qreg = (
+        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
+    )
+    shard_count = shard[1] if shard is not None else 1
+    plane = DataPlane(
+        shard=shard, num_workers=cfg.num_workers,
+        stall_timeout=getattr(cfg, "data_stall_timeout", 60.0),
+        quarantine_registry=qreg,
+    )
+    plane.register(
+        "source", seed=cfg.seed,
+        epoch_len=epoch_batch_count(len(source_ds), local_bs,
+                                    shard_count=shard_count),
+    )
+    plane.register(
+        "target", seed=cfg.seed + 1,
+        epoch_len=epoch_batch_count(len(target_ds), local_bs,
+                                    shard_count=shard_count),
+    )
+    plane.register(
+        "target_aug", seed=cfg.seed + 1,
+        epoch_len=epoch_batch_count(len(target_ds), local_bs,
+                                    shard_count=shard_count),
+        alias_of="target",
+    )
+    if plane.streams["source"].epoch_len == 0:
+        raise ValueError("datasets smaller than one batch")
+
     tx = officehome_tx(cfg)
 
     def build_model(axis_name=None):
@@ -1739,11 +1892,23 @@ def run_officehome(
             )
         state, src = resumed
         start_iter = int(state.step)
+        # Exact mid-epoch resume: every stream (source, target, and the
+        # aliased target-aug view) re-opens at its recorded (epoch,
+        # batch-cursor).  Legacy checkpoints (data_state: null) keep
+        # today's behavior — streams restart at epoch 0 — logged as a
+        # downgrade.
+        data_mode = _seek_data_plane(
+            plane, ckpt_dir=cfg.ckpt_dir, source=src,
+            step=start_iter, fallback_epoch=0,
+        )
         # Resume-only: a from-scratch restart (no periodic checkpoint) must
         # not inherit a stale best record from a dead trajectory — its
         # model_best would never update.
         best_acc = _read_best_record(cfg.ckpt_dir)
-        logger.log("resume", start_iter, source=src)
+        logger.log(
+            "resume", start_iter, source=src, data=data_mode,
+            cursor=plane.streams["target"].cursor,
+        )
 
     # Plan placement after every init/restore path has produced the
     # state (no-op except under a model-sharded plan — see run_digits).
@@ -1763,9 +1928,12 @@ def run_officehome(
     acc = 0.0
     coord = Coordinator()  # multi-host consensus; single-process: inert
     ckpt = _CkptPipeline(cfg, coord, plan)
-    qreg = (
-        QuarantineRegistry.for_ckpt_dir(cfg.ckpt_dir) if cfg.ckpt_dir else None
-    )
+    # Rollback re-seed base: continue the restored bump lineage (see
+    # run_digits).
+    bump0 = plane.seed_bump
+    # Step-aligned — see run_digits (guards the arithmetic memory-
+    # rollback seek).
+    step_aligned = not resuming or data_mode != "epoch_boundary"
 
     def _log_train(it, step_no, cls, mec):
         # Callers guard on the log cadence BEFORE evaluating the metric
@@ -1783,12 +1951,13 @@ def run_officehome(
         # cadence arithmetic — a missed cut there costs one extra
         # compile, never record ordering.
         targets = []
+        data_kw = {"data_state": plane.snapshot()}
         if cfg.ckpt_dir and (it + 1) % cfg.ckpt_every_iters == 0:
-            targets.append((cfg.ckpt_dir, _keep_kwargs(cfg)))
+            targets.append((cfg.ckpt_dir, {**_keep_kwargs(cfg), **data_kw}))
         if cfg.ckpt_dir and cfg.anchor_every and (
             (it + 1) % cfg.anchor_every == 0
         ):
-            targets.append((_anchor_dir(cfg.ckpt_dir), {}))
+            targets.append((_anchor_dir(cfg.ckpt_dir), dict(data_kw)))
         return targets
 
     def _boundary_actions(it):
@@ -1828,6 +1997,7 @@ def run_officehome(
                         int(state.step),
                         state,
                         keep=1,
+                        data_state=plane.snapshot(),
                     )
                 if best_path is not None:
                     best_acc = acc
@@ -1846,7 +2016,6 @@ def run_officehome(
     guard = _make_guard(cfg, logger)
     if guard:
         guard.prime(state)
-    seed_bump = 0  # bumped per rollback: re-seeds the shuffle streams
     # Async metric harvesting (ISSUE-14) — see run_digits.
     harvester = make_harvester(cfg, guard)
     flag_mode = guard is not None and harvester.async_mode
@@ -1904,31 +2073,19 @@ def run_officehome(
             harvester.drain()  # checkpoint boundary: records before save
             step = int(st.step)
             with wd.suspended():
-                ckpt.save(cfg.ckpt_dir, step, st, **_keep_kwargs(cfg))
+                ckpt.save(cfg.ckpt_dir, step, st,
+                          data_state=plane.snapshot(), **_keep_kwargs(cfg))
             logger.log("notice_save", step, sync=True)
             return step
 
         boundary.on_notice = _proactive_save
-        # Rollback retry loop: each attempt builds fresh (re-seeded)
-        # streams and trains from the current state; a RollbackRequest
-        # restores the newest valid checkpoint and starts a new attempt.
+        # Rollback retry loop: each attempt builds fresh streams from
+        # the plane's current (re-sought, re-seeded) position and trains
+        # from the current state; a RollbackRequest restores the newest
+        # valid checkpoint and starts a new attempt.
         while True:
-            source_stream = infinite(
-                lambda e: batch_iterator(source_ds, local_bs, shuffle=True,
-                                         seed=cfg.seed + seed_bump, epoch=e,
-                                         shard=shard,
-                                         num_workers=cfg.num_workers,
-                                         quarantine_registry=qreg,
-                                         quarantine_key="source")
-            )
-            target_stream = infinite(
-                lambda e: batch_iterator(target_ds, local_bs, shuffle=True,
-                                         seed=cfg.seed + 1 + seed_bump,
-                                         epoch=e, shard=shard,
-                                         num_workers=cfg.num_workers,
-                                         quarantine_registry=qreg,
-                                         quarantine_key="target")
-            )
+            source_stream = plane.stream(source_ds, "source", local_bs)
+            target_stream = plane.stream(target_ds, "target", local_bs)
 
             def train_batches():
                 # Finite (num_iters - start_iter) stream so the prefetch
@@ -1961,6 +2118,7 @@ def run_officehome(
                     ):
                         with obs.span("step_dispatch"):
                             state, metrics = train_step(state, batch)
+                        plane.advance(1)  # one batch per stream consumed
                         state, metrics = inject.maybe_nan(
                             state, metrics, step0 + it + 1
                         )
@@ -1997,6 +2155,7 @@ def run_officehome(
 
                     def on_steps(st, n, ms):
                         nonlocal it, state
+                        plane.advance(n)  # n batches per stream consumed
                         state, ms = inject.maybe_nan(
                             st, ms, step0 + it + 1, step0 + it + n
                         )
@@ -2049,12 +2208,25 @@ def run_officehome(
                 ckpt.finalize(raise_errors=False)
                 # Unmasked: the rollback consensus collectives must stay
                 # watchable (see run_digits).
-                state = _rollback_state(
+                state, rb_src = _rollback_state(
                     cfg, logger, guard, state, rb.step, coord, plan
                 )
                 wd.heartbeat()
                 start_iter = int(state.step)
-                seed_bump = guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                # Exact cursor re-seek, then the seed-lineage bump (see
+                # run_digits' rollback handler).
+                rb_mode = _seek_data_plane(
+                    plane, ckpt_dir=cfg.ckpt_dir, source=rb_src,
+                    step=start_iter, fallback_epoch=0,
+                    exact_step=start_iter,
+                    arith_ok=step_aligned and guard.recoveries == 0,
+                )
+                if rb_mode == "epoch_boundary":
+                    # Misaligned from here on — see run_digits' rollback.
+                    step_aligned = False
+                plane.seed_bump = (
+                    bump0 + guard.rollbacks * _ROLLBACK_SEED_STRIDE
+                )
                 continue
             finally:
                 # Boundary drain (ISSUE-14) on EVERY exit, incl. the
@@ -2096,6 +2268,7 @@ def run_officehome(
                     if resume_step is None:
                         ckpt.save(
                             cfg.ckpt_dir, int(state.step), state,
+                            data_state=plane.snapshot(),
                             **_keep_kwargs(cfg),
                         )
                     # else: the proactive save is durable — exit fast,
@@ -2169,7 +2342,8 @@ def run_officehome(
     if cfg.ckpt_dir:
         # Post-stat-collection state is the run's artifact; save + flush
         # (effectively synchronous — nothing overlaps a final save).
-        ckpt.save(cfg.ckpt_dir, int(state.step), state, **_keep_kwargs(cfg))
+        ckpt.save(cfg.ckpt_dir, int(state.step), state,
+                  data_state=plane.snapshot(), **_keep_kwargs(cfg))
         ckpt.flush()
     obs.export()  # normal-exit trace flush (no-op when tracing is off)
     return acc

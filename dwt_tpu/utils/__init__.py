@@ -12,6 +12,7 @@ from dwt_tpu.utils.checkpoint import (
     checkpoint_invalid_reason,
     is_valid_checkpoint,
     latest_step,
+    load_data_state,
     ranked_checkpoints,
     restore_newest,
     restore_state,
@@ -36,6 +37,7 @@ __all__ = [
     "checkpoint_invalid_reason",
     "is_valid_checkpoint",
     "latest_step",
+    "load_data_state",
     "ranked_checkpoints",
     "restore_newest",
     "restore_state",

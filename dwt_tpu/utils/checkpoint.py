@@ -382,9 +382,28 @@ def tree_all_finite(tree: Any) -> bool:
     return bool(verdict)
 
 
+def load_data_state(step_dir: str) -> Optional[dict]:
+    """The checkpoint's recorded ``data_state`` (the data plane's
+    per-stream seed-lineage/epoch/batch-cursor snapshot —
+    ``dwt_tpu.data.pipeline.DataPlane.snapshot``), or None.
+
+    All three on-disk formats store it in the step's top-level manifest
+    (the host-shard format stamps it at promotion from shard 0's
+    manifest), so one reader serves resume, guard rollback, and the
+    offline auditor.  None — a legacy checkpoint, a manifest-less
+    artifact, or a save made without a data plane — means the caller
+    takes the epoch-boundary fallback and logs the downgrade.
+    """
+    manifest = _read_manifest(step_dir)
+    if manifest is None:
+        return None
+    ds = manifest.get("data_state")
+    return ds if isinstance(ds, dict) else None
+
+
 def save_state(
     ckpt_dir: str, step: int, state: Any, keep: Optional[int] = None,
-    require_finite: bool = True,
+    require_finite: bool = True, data_state: Optional[dict] = None,
 ) -> Optional[str]:
     """Atomically write ``state`` under ``ckpt_dir/<step>``; returns the path.
 
@@ -444,7 +463,11 @@ def save_state(
         _with_retries(_write, f"checkpoint save @{step}")
         if primary:
             _write_manifest(
-                tmp, step, params_digest(getattr(state, "params", state))
+                tmp, step, params_digest(getattr(state, "params", state)),
+                extra=(
+                    {"data_state": data_state} if data_state is not None
+                    else None
+                ),
             )
             # Fault hook: a preemption/SIGKILL landing here leaves only the
             # unfinalized tmp dir — exactly what restore must survive.
@@ -556,7 +579,7 @@ def host_tree_all_finite(host_tree: Any) -> bool:
 
 def save_host_shard(
     ckpt_dir: str, step: int, host_state: Any, process_index: int,
-    require_finite: bool = True,
+    require_finite: bool = True, data_state: Optional[dict] = None,
 ) -> bool:
     """Write THIS process's replica of ``host_state`` (numpy leaves, from
     :func:`host_fetch`) under ``.tmp-mh-<step>/shard_<process_index>``.
@@ -616,6 +639,11 @@ def save_host_shard(
             "leaves": leaves,
             "files": {_LEAVES_FILE: offset},
         }
+        if data_state is not None:
+            # Promotion copies shard 0's data_state into the top-level
+            # manifest; the saves come from lockstep control flow, so
+            # every shard records the identical snapshot.
+            manifest["data_state"] = data_state
         tmp_manifest = os.path.join(shard, SHARD_MANIFEST + ".tmp")
         with open(tmp_manifest, "w") as f:
             json.dump(manifest, f, indent=1)
@@ -665,6 +693,7 @@ def promote_host_shards(
         # success, not a torn-shard error.
         return final
     digest = None
+    data_state = None
     for p in range(int(process_count)):
         shard_dir = os.path.join(tmp, f"shard_{p}")
         manifest = _read_shard_manifest(shard_dir)
@@ -676,13 +705,14 @@ def promote_host_shards(
             )
         if p == 0:
             digest = manifest.get("params_digest")
-    _write_manifest(
-        tmp, step, digest,
-        extra={
-            "format": HOST_SHARD_FORMAT,
-            "process_count": int(process_count),
-        },
-    )
+            data_state = manifest.get("data_state")
+    extra = {
+        "format": HOST_SHARD_FORMAT,
+        "process_count": int(process_count),
+    }
+    if data_state is not None:
+        extra["data_state"] = data_state
+    _write_manifest(tmp, step, digest, extra=extra)
     _finalize_rename(root, tmp, final, step)
     _sweep_stale_tmp(root)
     if keep is not None:

@@ -47,16 +47,18 @@ def _disarm_faults():
     inject.disarm()
 
 
-def _run_digits(tmp_path, plan, extra=(), timeout=300):
+def _run_digits(tmp_path, plan, extra=(), timeout=300, env_extra=None,
+                ck=None, jsonl=None):
     """Spawn the digits CLI with ``plan`` armed; kill-on-timeout enforces
     the matrix's no-hang guarantee from outside the process."""
-    ck = str(tmp_path / "ck")
-    jsonl = str(tmp_path / "m.jsonl")
+    ck = ck or str(tmp_path / "ck")
+    jsonl = jsonl or str(tmp_path / "m.jsonl")
     argv = [
         sys.executable, "-m", "dwt_tpu.cli.usps_mnist",
         *_BASE_ARGS, "--ckpt_dir", ck, "--metrics_jsonl", jsonl, *extra,
     ]
     env = dict(os.environ)
+    env.update(env_extra or {})
     env[inject.ENV_VAR] = json.dumps(plan)
     proc = subprocess.Popen(
         argv, cwd=REPO, env=env,
@@ -220,6 +222,218 @@ def test_chaos_sigterm_drain_loses_no_records(tmp_path):
         i for i, k in enumerate(kinds) if k == "train"
     )
     assert _assert_resumable(ck) == 6
+
+
+# ----------------------------------------- exact mid-epoch resume (ISSUE-15)
+
+# The digits chaos geometry: synthetic_size 32 / global batch 8 -> 4
+# batches per epoch per stream, and the zipped loop consumes one batch
+# per stream per step, so global batch index = epoch * 4 + cursor.
+_STEPS_PER_EPOCH = 4
+
+
+def _read_trail(trail_dir, role):
+    """[(epoch, cursor, ids), ...] in production order (may contain
+    positions produced-ahead by the prefetch thread but never trained)."""
+    path = os.path.join(trail_dir, f"{role}.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [
+        (r["epoch"], r["cursor"], r["ids"])
+        for r in map(json.loads, open(path).read().splitlines())
+    ]
+
+
+def test_chaos_sigterm_mid_epoch_exact_resume(tmp_path):
+    """Tentpole acceptance (ISSUE-15): a SIGTERM mid-epoch, then a
+    relaunch, replays exactly the remaining batch-id sequence — no
+    duplicate, no loss — byte-identical to an uninterrupted golden run,
+    for every stream.  Proven from outside via the DWT_DATA_TRAIL
+    batch-id trail: the resumed run's first produced batch is exactly
+    the checkpoint's recorded cursor, and every resumed position's ids
+    equal the golden run's."""
+    gold_dir = str(tmp_path / "trail_gold")
+    rc, _, _, stderr = _run_digits(
+        tmp_path, {}, extra=("--epochs", "3"),
+        env_extra={"DWT_DATA_TRAIL": gold_dir},
+        ck=str(tmp_path / "gold_ck"), jsonl=str(tmp_path / "gold.jsonl"),
+    )
+    assert rc == 0, stderr[-2000:]
+
+    kill_dir = str(tmp_path / "trail_kill")
+    ck = str(tmp_path / "ck")
+    rc, _, _, stderr = _run_digits(
+        tmp_path, {"sigterm_at_step": 6}, extra=("--epochs", "500"),
+        env_extra={"DWT_DATA_TRAIL": kill_dir}, ck=ck,
+        jsonl=str(tmp_path / "kill.jsonl"),
+    )
+    assert rc == 0, stderr[-2000:]
+    assert _assert_resumable(ck) == 6  # mid-epoch: epoch 1, cursor 2
+
+    resume_dir = str(tmp_path / "trail_resume")
+    rc, _, jsonl, stderr = _run_digits(
+        tmp_path, {}, extra=("--epochs", "3"),
+        env_extra={"DWT_DATA_TRAIL": resume_dir}, ck=ck,
+        jsonl=str(tmp_path / "resume.jsonl"),
+    )
+    assert rc == 0, stderr[-2000:]
+    recs = [json.loads(l) for l in open(jsonl).read().splitlines()]
+    res = [r for r in recs if r["kind"] == "resume"][-1]
+    assert res["step"] == 6 and res["data"] == "exact" and res["cursor"] == 2
+
+    for role in ("source", "target"):
+        golden = {(e, c): ids for e, c, ids in _read_trail(gold_dir, role)}
+        resumed = _read_trail(resume_dir, role)
+        assert resumed, f"no resumed trail for {role}"
+        # The resume opens EXACTLY at the recorded cursor — the very
+        # first produced batch is global index 6 = (epoch 1, cursor 2):
+        # nothing before it is replayed (no duplicate)...
+        assert (resumed[0][0], resumed[0][1]) == (1, 2), role
+        # ...and the remaining sequence is complete and contiguous (no
+        # loss), byte-identical to the golden run's ids at every
+        # position.
+        want = [(1, 2), (1, 3), (2, 0), (2, 1), (2, 2), (2, 3)]
+        assert [(e, c) for e, c, _ in resumed] == want, role
+        for e, c, ids in resumed:
+            assert ids == golden[(e, c)], (role, e, c)
+
+
+@pytest.mark.slow
+def test_chaos_rollback_reseeks_mid_epoch_cursor(tmp_path):
+    """Sibling acceptance: a guard rollback to a MID-epoch checkpoint
+    (the notice-driven step-6 save) re-opens every stream at the exact
+    recorded cursor — not the epoch boundary — with the rollback's
+    re-seeded shuffle order.  The post-rollback ids are verified against
+    the seekable sampler directly (the order is a pure function of
+    (seed + bump, epoch), so the expectation needs no golden run)."""
+    from dwt_tpu.data import SeekableSampler
+    from dwt_tpu.train.loop import _ROLLBACK_SEED_STRIDE
+
+    trail = str(tmp_path / "trail")
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        {"notice_at_step": 6, "nan_at_step": 7},
+        extra=("--epochs", "3", "--guard_policy", "rollback",
+               "--guard_interval", "1", "--harvest_depth", "0"),
+        env_extra={"DWT_DATA_TRAIL": trail},
+    )
+    assert rc == 0, stderr[-2000:]
+    recs = [json.loads(l) for l in open(jsonl).read().splitlines()]
+    rb = [r for r in recs if r["kind"] == "rollback"]
+    assert rb and rb[0]["step"] == 6  # restored the mid-epoch notice save
+    assert _assert_resumable(ck) == 12  # trained to completion
+
+    for role, seed in (("source", 1), ("target", 2)):
+        entries = _read_trail(trail, role)
+        # The re-seek: position (1, 2) is produced TWICE — once in the
+        # first attempt (pre-divergence order), once after the rollback
+        # (re-seeded order) — and the second time its ids come from the
+        # BUMPED seed lineage at the same cursor.
+        hits = [i for i, (e, c, _) in enumerate(entries) if (e, c) == (1, 2)]
+        assert len(hits) == 2, (role, hits)
+        replay = entries[hits[1]:]
+        assert [(e, c) for e, c, _ in replay] == [
+            (1, 2), (1, 3), (2, 0), (2, 1), (2, 2), (2, 3)
+        ], role
+        bump = _ROLLBACK_SEED_STRIDE
+        for e, c, ids in replay:
+            sampler = SeekableSampler(32, seed=seed + bump, epoch=e)
+            want = sampler.positions(c * 8, (c + 1) * 8).tolist()
+            assert ids == want, (role, e, c)
+
+
+@pytest.mark.slow
+def test_chaos_dead_worker_detected_and_survived(tmp_path):
+    """ISSUE-15 satellite: a pool worker dying mid-epoch (dead_worker_at)
+    is detected at --data_stall_timeout, logged, respawned, and the run
+    completes with the batch order intact (the golden-free invariant:
+    the trail equals the no-fault sampler order — a substitution never
+    happened, the item itself was fine)."""
+    trail = str(tmp_path / "trail")
+    rc, ck, jsonl, stderr = _run_digits(
+        tmp_path,
+        {"dead_worker_at": {"source": [3]}},
+        extra=("--epochs", "2", "--data_stall_timeout", "2"),
+        env_extra={"DWT_DATA_TRAIL": trail},
+    )
+    assert rc == 0, stderr[-2000:]
+    assert "stalled" in stderr
+    assert _assert_resumable(ck) == 8
+    from dwt_tpu.data import SeekableSampler
+
+    for e, c, ids in _read_trail(trail, "source"):
+        want = SeekableSampler(32, seed=1, epoch=e).positions(
+            c * 8, (c + 1) * 8
+        ).tolist()
+        assert ids == want, (e, c)
+
+
+@pytest.mark.slow
+def test_chaos_two_process_sharded_exact_resume(tmp_path):
+    """Acceptance: exact mid-epoch resume under the 2-process sharded
+    split — each process's trail (its own shard slice) is byte-identical
+    to its golden twin's remaining sequence after SIGTERM + relaunch,
+    and the shared checkpoint carries ONE data_state both ranks agree
+    on."""
+    def spawn(rank_plans, extra, tag):
+        return _spawn_two_process_digits(
+            tmp_path, rank_plans,
+            extra=(*extra, "--ckpt_every_epochs", "1000"),
+            env_extra={
+                r: {"DWT_DATA_TRAIL": str(tmp_path / f"trail_{tag}_{r}")}
+                for r in range(2)
+            },
+            ck=str(tmp_path / f"ck_{tag}"),
+        )
+
+    results, _ = spawn({}, ("--epochs", "3"), "gold")
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"gold rank {rank}:\n{out[-3000:]}"
+
+    results, _ = spawn(
+        {1: {"sigterm_at_step": 6}}, ("--epochs", "500"), "kill"
+    )
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"kill rank {rank}:\n{out[-3000:]}"
+    ck = str(tmp_path / "ck_kill")
+    assert latest_step(ck) == 6
+    from dwt_tpu.utils.checkpoint import load_data_state
+
+    ds = load_data_state(os.path.join(ck, "6"))
+    # 64 items / global batch 8 -> 8 steps per epoch; step 6 = cursor 6.
+    assert ds["streams"]["source"]["epoch"] == 0
+    assert ds["streams"]["source"]["cursor"] == 6
+
+    results, logs = spawn({}, ("--epochs", "3"), "kill")  # resume, same ck
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"resume rank {rank}:\n{out[-3000:]}"
+    for path in logs:
+        recs = [json.loads(l) for l in open(path).read().splitlines()]
+        res = [r for r in recs if r["kind"] == "resume"][-1]
+        assert res["step"] == 6 and res["data"] == "exact"
+
+    for rank in range(2):
+        for role in ("source", "target"):
+            gold = {
+                (e, c): ids for e, c, ids in _read_trail(
+                    str(tmp_path / f"trail_gold_{rank}"), role
+                )
+            }
+            resumed = _read_trail(
+                str(tmp_path / f"trail_kill_{rank}"), role
+            )
+            # The kill run's trail, then the resume run's appended to the
+            # same per-rank file: the resumed portion starts at (0, 6).
+            tail = resumed[
+                max(i for i, (e, c, _) in enumerate(resumed)
+                    if (e, c) == (0, 6)):
+            ]
+            want = [(0, 6), (0, 7)] + [
+                (e, c) for e in range(1, 3) for c in range(8)
+            ]
+            assert [(e, c) for e, c, _ in tail] == want, (rank, role)
+            for e, c, ids in tail:
+                assert ids == gold[(e, c)], (rank, role, e, c)
 
 
 # ------------------------------------------------------- full matrix (slow)
@@ -424,16 +638,19 @@ def test_chaos_two_process_consensus_sigterm_one_host(tmp_path):
     assert json.load(open(ck / "3" / "manifest.json"))["format"] == "host_shards"
 
 
-def _spawn_two_process_digits(tmp_path, rank_plans, extra=(), timeout=480):
+def _spawn_two_process_digits(tmp_path, rank_plans, extra=(), timeout=480,
+                              env_extra=None, ck=None):
     """Launch the 2-process digits trainer (shared ckpt_dir, consensus
-    path); ``rank_plans[r]`` arms a fault plan in rank r's env only.
+    path); ``rank_plans[r]`` arms a fault plan in rank r's env only,
+    ``env_extra[r]`` adds env vars there (e.g. a per-rank trail dir).
     Returns ``[(returncode, output), ...]``; kill-on-timeout enforces the
     no-hang contract from outside."""
     port = _free_port()
     procs, logs = [], []
     for rank in range(2):
         env = {k: v for k, v in os.environ.items()
-               if k not in ("PALLAS_AXON_POOL_IPS", inject.ENV_VAR)}
+               if k not in ("PALLAS_AXON_POOL_IPS", inject.ENV_VAR,
+                            "DWT_DATA_TRAIL")}
         env.update(
             JAX_PLATFORMS="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
@@ -442,6 +659,7 @@ def _spawn_two_process_digits(tmp_path, rank_plans, extra=(), timeout=480):
             DWT_PROCESS_ID=str(rank),
             PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
         )
+        env.update((env_extra or {}).get(rank, {}))
         if rank_plans.get(rank):
             env[inject.ENV_VAR] = json.dumps(rank_plans[rank])
         jsonl = str(tmp_path / f"metrics_{rank}.jsonl")
@@ -459,7 +677,7 @@ def _spawn_two_process_digits(tmp_path, rank_plans, extra=(), timeout=480):
                     "--num_workers", "0",
                     "--log_interval", "1",
                     "--metrics_jsonl", jsonl,
-                    "--ckpt_dir", str(tmp_path / "shared_ck"),
+                    "--ckpt_dir", ck or str(tmp_path / "shared_ck"),
                     *extra,
                 ],
                 env=env,

@@ -1,0 +1,607 @@
+"""Checkpointable data plane (ISSUE-15): seekable samplers, exact
+mid-epoch seek, per-stream DataState through every checkpoint format,
+the ordered-reassembly worker pipeline's stall detection, and the
+offline checkpoint auditor.
+
+The subprocess exact-resume proofs (SIGTERM mid-epoch → byte-identical
+remaining batch-id trail; rollback re-seeking the cursor; 2-process
+sharded) live in tests/test_chaos.py beside the rest of the chaos
+matrix.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dwt_tpu.data import (
+    ArrayDataset,
+    DataPlane,
+    OrderedWorkerPool,
+    SeekableSampler,
+    batch_iterator,
+    epoch_batch_count,
+)
+from dwt_tpu.resilience import inject
+from dwt_tpu.resilience.inject import FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    inject.disarm()
+
+
+# ------------------------------------------------------------- sampler
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 7, 32, 63, 1000, 4097])
+def test_sampler_is_a_permutation(n):
+    s = SeekableSampler(n, seed=5, epoch=2)
+    full = s.positions()
+    assert sorted(full.tolist()) == list(range(n))
+
+
+def test_sampler_deterministic_and_epoch_varying():
+    a = SeekableSampler(100, seed=5, epoch=2).positions()
+    b = SeekableSampler(100, seed=5, epoch=2).positions()
+    np.testing.assert_array_equal(a, b)
+    c = SeekableSampler(100, seed=5, epoch=3).positions()
+    d = SeekableSampler(100, seed=6, epoch=2).positions()
+    assert not np.array_equal(a, c)
+    assert not np.array_equal(a, d)
+
+
+def test_sampler_seek_matches_full_order():
+    """THE seek contract: mapping positions [k:] equals slicing the full
+    materialized order — a mid-epoch resume never replays the prefix."""
+    s = SeekableSampler(257, seed=9, epoch=4)
+    full = s.positions()
+    for k in (1, 16, 200, 256):
+        np.testing.assert_array_equal(s.positions(k), full[k:])
+    assert s[13] == int(full[13])
+    # Arbitrary (non-contiguous) position sets map too.
+    np.testing.assert_array_equal(
+        s.take([3, 100, 7]), full[[3, 100, 7]]
+    )
+
+
+def test_sampler_no_shuffle_is_identity_and_bounds_checked():
+    s = SeekableSampler(10, seed=1, epoch=0, shuffle=False)
+    np.testing.assert_array_equal(s.positions(), np.arange(10))
+    with pytest.raises(IndexError):
+        SeekableSampler(10, seed=1, epoch=0).take([10])
+
+
+def test_epoch_batch_count_matches_iterator():
+    for n, bs, count in [(63, 16, 2), (32, 8, 1), (10, 4, 1), (37, 4, 2)]:
+        ds = ArrayDataset(np.zeros((n, 1), np.float32), np.arange(n))
+        for index in range(count):
+            got = len(list(batch_iterator(
+                ds, bs, shuffle=True,
+                shard=(index, count) if count > 1 else None,
+            )))
+            assert got == epoch_batch_count(n, bs, shard_count=count)
+
+
+# --------------------------------------------------- start_batch seek
+
+
+def _ds(n=37):
+    return ArrayDataset(np.arange(n, dtype=np.float32)[:, None], np.arange(n))
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(shuffle=True, drop_last=True, seed=3, epoch=2),
+    dict(shuffle=True, drop_last=True, seed=3, epoch=2, shard=(1, 2)),
+    dict(shuffle=True, drop_last=True, seed=3, epoch=2, num_workers=4),
+    dict(shuffle=True, drop_last=False, seed=1),
+])
+def test_batch_iterator_start_batch_is_exact_suffix(kwargs):
+    full = list(batch_iterator(_ds(), 4, **kwargs))
+    for k in (0, 1, 3):
+        part = list(batch_iterator(_ds(), 4, start_batch=k, **kwargs))
+        assert len(part) == len(full) - k
+        for a, b in zip(full[k:], part):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+
+
+def test_batch_iterator_start_batch_refused_on_eval_path():
+    with pytest.raises(ValueError, match="train-path resume cursor"):
+        next(iter(batch_iterator(
+            _ds(), 4, shuffle=False, drop_last=False, pad_and_mask=True,
+            start_batch=1,
+        )))
+
+
+def test_batch_ids_hook_reports_emitted_ids():
+    got = []
+    batches = list(batch_iterator(
+        _ds(), 4, shuffle=True, seed=3, on_batch_ids=got.append
+    ))
+    assert len(got) == len(batches)
+    for ids, b in zip(got, batches):
+        assert ids == [int(v) for v in b[1]]  # labels == indices here
+
+
+# -------------------------------------------------- substitution
+
+
+class _CorruptAt:
+    def __init__(self, n=16, bad=(5,)):
+        self.n, self.bad = n, frozenset(bad)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if int(i) in self.bad:
+            raise OSError(f"corrupt item {i}")
+        return np.float32(i), i
+
+
+def test_resume_at_quarantined_cursor_matches_golden_substitute():
+    """A quarantined item sitting exactly AT the resume cursor must
+    substitute the nearest PRECEDING good item — the one the
+    uninterrupted epoch used — not fall into the deficit path and repay
+    with the following item (which would silently break byte-identity
+    exactly when quarantine and preemption compose)."""
+    for kwargs in (dict(shuffle=False), dict(shuffle=True, seed=3),
+                   dict(shuffle=True, seed=3, shard=(1, 2))):
+        golden = list(batch_iterator(
+            _CorruptAt(32, bad=()), 4, substitute=True, **kwargs
+        ))
+        # Find which batch each item lands in, then quarantine the FIRST
+        # item of batch 2 so the resumed iterator opens on it.
+        bad_id = int(golden[2][1][0])
+        faulty = lambda: _CorruptAt(32, bad=(bad_id,))
+        full = list(batch_iterator(faulty(), 4, substitute=True, **kwargs))
+        part = list(batch_iterator(faulty(), 4, substitute=True,
+                                   start_batch=2, **kwargs))
+        assert len(part) == len(full) - 2, kwargs
+        for a, b in zip(full[2:], part):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+
+
+def test_substitution_keeps_epoch_length_fixed():
+    """The data plane's core invariant: with substitute=True a
+    quarantined item never shifts batch boundaries — positions stay
+    pure functions of the step, which is what makes seek exact."""
+    subs = []
+    fixed = list(batch_iterator(
+        _CorruptAt(), 4, shuffle=False, substitute=True,
+        on_substitute=lambda: subs.append(1),
+    ))
+    assert len(fixed) == 4 and len(subs) == 1
+    # Legacy drop semantics (the default) shorten the epoch — unchanged.
+    assert len(list(batch_iterator(_CorruptAt(), 4, shuffle=False))) == 3
+
+
+# ------------------------------------------- ordered worker pipeline
+
+
+class _HangFirstAccess:
+    """Item 3's first access never returns — a dead worker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        if int(i) == 3:
+            with self._lock:
+                first = self._seen == 0
+                self._seen += 1
+            if first:
+                threading.Event().wait()
+        return np.float32(i), i
+
+
+def test_pool_detects_dead_worker_and_recovers_in_order(caplog):
+    t0 = time.perf_counter()
+    with caplog.at_level("WARNING", logger="dwt_tpu.data.pipeline"):
+        out = list(batch_iterator(
+            _HangFirstAccess(), 4, shuffle=False, num_workers=2,
+            stall_timeout=0.3,
+        ))
+    elapsed = time.perf_counter() - t0
+    ys = np.concatenate([b[1] for b in out])
+    np.testing.assert_array_equal(ys, np.arange(16))  # order preserved
+    assert elapsed < 5.0  # one stall_timeout + slack, not a wedged epoch
+    assert any("stalled" in r.message for r in caplog.records)
+
+
+class _HangMany:
+    """Items in ``bad`` hang forever on their first access — enough of
+    them to wedge EVERY original pool worker."""
+
+    def __init__(self, n=24, bad=(3, 5)):
+        self.n, self.bad = n, frozenset(bad)
+        self._lock = threading.Lock()
+        self._seen = {}
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        i = int(i)
+        if i in self.bad:
+            with self._lock:
+                first = self._seen.setdefault(i, 0) == 0
+                self._seen[i] += 1
+            if first:
+                threading.Event().wait()
+        return np.float32(i), i
+
+
+def test_pool_recovers_capacity_when_all_workers_wedge():
+    """A dead worker costs one timeout, not one timeout per remaining
+    item: with BOTH original workers wedged, replacement workers spawned
+    at stall detection drain the rest of the epoch — total wall stays
+    ~per-wedged-item timeouts, never O(items) timeouts."""
+    t0 = time.perf_counter()
+    out = list(batch_iterator(_HangMany(), 4, shuffle=False, num_workers=2,
+                              stall_timeout=0.3))
+    elapsed = time.perf_counter() - t0
+    ys = np.concatenate([b[1] for b in out])
+    np.testing.assert_array_equal(ys, np.arange(24))
+    # 2 wedged items -> ~2 detection timeouts (+ slack); the pre-fix
+    # cascade cost one timeout for EACH of the ~19 following items.
+    assert elapsed < 2.5, elapsed
+
+
+def test_pool_propagates_item_errors_at_position():
+    pool = OrderedWorkerPool(2, stall_timeout=5.0)
+
+    def load(i):
+        if i == 3:
+            raise OSError("boom")
+        return i * 10
+
+    it = pool.imap(load, range(6))
+    assert [next(it) for _ in range(3)] == [0, 10, 20]
+    with pytest.raises(OSError, match="boom"):
+        next(it)
+
+
+def test_dead_worker_fault_kind_drives_the_pipeline():
+    """inject.dead_worker_at → FlakyDataset hang → stall detection →
+    respawned item → epoch completes, order intact (the chaos-drivable
+    contract, in-process)."""
+    inject.arm(FaultPlan(dead_worker_at={"source": [2]}))
+    ds = inject.wrap_dataset(_ds(16), "source")
+    out = list(batch_iterator(ds, 4, shuffle=False, num_workers=2,
+                              stall_timeout=0.3))
+    ys = np.concatenate([b[1] for b in out])
+    np.testing.assert_array_equal(ys, np.arange(16))
+
+
+def test_slow_item_fault_kind_stalls_once_in_order():
+    inject.arm(FaultPlan(slow_item_at={"target": [1]}, slow_item_s=0.3))
+    ds = inject.wrap_dataset(_ds(8), "target")
+    t0 = time.perf_counter()
+    out = list(batch_iterator(ds, 4, shuffle=False, num_workers=2))
+    assert time.perf_counter() - t0 >= 0.3
+    ys = np.concatenate([b[1] for b in out])
+    np.testing.assert_array_equal(ys, np.arange(8))
+
+
+@pytest.mark.parametrize("spec,match", [
+    ({"dead_worker_at": [1]}, "map a stream role"),
+    ({"dead_worker_at": {"eval": [1]}}, "source"),
+    ({"slow_item_at": {"source": [2, 2]}}, "duplicate"),
+    ({"slow_item_s": 0.5}, "arms nothing"),
+    ({"slow_item_at": {"source": [1]}, "slow_item_s": -1}, "non-negative"),
+])
+def test_new_fault_kinds_reject_bad_specs(spec, match):
+    with pytest.raises(ValueError, match=match):
+        FaultPlan.from_spec(spec)
+
+
+# ------------------------------------------------------- DataPlane
+
+
+def _plane(epoch_len=4, **kw):
+    plane = DataPlane(num_workers=0, **kw)
+    plane.register("source", seed=7, epoch_len=epoch_len)
+    return plane
+
+
+def test_plane_advance_rolls_epochs_and_seeks():
+    plane = _plane()
+    plane.advance(10)
+    pos = plane.streams["source"]
+    assert (pos.epoch, pos.cursor) == (2, 2)
+    plane.seek_step(7)
+    assert (pos.epoch, pos.cursor) == (1, 3)
+    plane.seek_epoch(5)
+    assert (pos.epoch, pos.cursor) == (5, 0)
+
+
+def test_plane_snapshot_roundtrip_and_refusals():
+    plane = _plane()
+    plane.seed_bump = 11
+    plane.advance(6)
+    snap = plane.snapshot()
+    assert snap["version"] == 1 and snap["seed_bump"] == 11
+
+    other = _plane()
+    assert other.load_snapshot(snap)
+    assert other.seed_bump == 11
+    assert (other.streams["source"].epoch,
+            other.streams["source"].cursor) == (1, 2)
+
+    assert not _plane().load_snapshot(None)
+    assert not _plane().load_snapshot({"version": 99})
+    assert not _plane(epoch_len=5).load_snapshot(snap)  # geometry moved
+    extra = _plane()
+    extra.register("target", seed=8, epoch_len=4)
+    assert not extra.load_snapshot(snap)  # stream sets differ
+    reseeded = DataPlane(num_workers=0)
+    reseeded.register("source", seed=99, epoch_len=4)
+    assert not reseeded.load_snapshot(snap)  # --seed changed: the
+    # recorded cursor indexes a different permutation — refuse, don't
+    # silently seek into the wrong order
+
+
+def test_plane_alias_advances_and_counts_with_parent():
+    plane = _plane()
+    plane.register("target", seed=8, epoch_len=4)
+    plane.register("target_aug", seed=8, epoch_len=4, alias_of="target")
+    plane.advance(5)
+    assert plane.streams["target_aug"].cursor == 1
+    plane.note_substitution("target")
+    assert plane.streams["target"].quarantine_subs == 1
+    assert plane.streams["target_aug"].quarantine_subs == 1
+    assert plane.snapshot()["streams"]["target_aug"]["alias_of"] == "target"
+
+
+def test_plane_stream_resumes_mid_epoch_bitwise():
+    """The in-process half of the exact-resume proof: a stream re-opened
+    at (epoch, cursor) yields the bitwise suffix of an uninterrupted
+    golden stream."""
+    def mk():
+        return _ds(16)
+
+    golden_plane = _plane()
+    s = golden_plane.stream(mk(), "source", 4)
+    golden = [next(s)[1] for _ in range(14)]
+    s.close()
+
+    plane = _plane()
+    s = plane.stream(mk(), "source", 4)
+    for _ in range(9):
+        next(s)
+    s.close()
+    plane.advance(9)
+
+    resumed = _plane()
+    assert resumed.load_snapshot(plane.snapshot())
+    s = resumed.stream(mk(), "source", 4)
+    rest = [next(s)[1] for _ in range(5)]
+    s.close()
+    for a, b in zip(golden[9:], rest):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_plane_trail_records_ids_per_position(tmp_path, monkeypatch):
+    monkeypatch.setenv("DWT_DATA_TRAIL", str(tmp_path / "trail"))
+    plane = _plane()
+    list(plane.epoch_iterator(_ds(16), "source", 4))
+    lines = [json.loads(l) for l in
+             open(tmp_path / "trail" / "source.jsonl")]
+    assert [(l["epoch"], l["cursor"]) for l in lines] == [
+        (0, 0), (0, 1), (0, 2), (0, 3)
+    ]
+    assert sorted(i for l in lines for i in l["ids"]) == list(range(16))
+
+
+# ------------------------------------- data_state in checkpoint formats
+
+
+def _snap():
+    plane = _plane()
+    plane.advance(6)
+    return plane.snapshot()
+
+
+def test_data_state_roundtrips_all_three_formats(tmp_path):
+    import jax.numpy as jnp
+    from flax import struct
+
+    from dwt_tpu.ckpt.store import save_delta
+    from dwt_tpu.utils.checkpoint import (
+        host_fetch,
+        load_data_state,
+        promote_host_shards,
+        save_host_shard,
+        save_state,
+    )
+
+    @struct.dataclass
+    class S:
+        params: dict
+        step: jnp.ndarray
+
+    snap = _snap()
+    s = S(params={"w": jnp.ones((3,))}, step=jnp.asarray(7))
+
+    p = save_state(str(tmp_path / "orbax"), 7, s, data_state=snap)
+    assert load_data_state(p) == snap
+    assert load_data_state(save_state(str(tmp_path / "orbax"), 8, s)) is None
+
+    host = host_fetch(s)
+    p = save_delta(str(tmp_path / "cas"), 7, host, data_state=snap)
+    assert load_data_state(p) == snap
+    p = save_delta(str(tmp_path / "cas"), 9, host, data_state=snap)
+    assert load_data_state(p) == snap  # delta manifests carry their own copy
+
+    assert save_host_shard(str(tmp_path / "mh"), 5, host, 0, data_state=snap)
+    p = promote_host_shards(str(tmp_path / "mh"), 5, 1)
+    assert load_data_state(p) == snap
+
+
+# ------------------------------------------------------ resume seek modes
+
+
+def test_seek_data_plane_modes(tmp_path, caplog):
+    """The three resume modes: exact (recorded data_state),
+    exact_arith (memory snapshot — position is step arithmetic), and
+    epoch_boundary (old-format checkpoint, data_state: null) with the
+    downgrade LOGGED — the acceptance's legacy-fallback contract."""
+    import jax.numpy as jnp
+    from flax import struct
+
+    from dwt_tpu.train.loop import _seek_data_plane
+    from dwt_tpu.utils.checkpoint import save_state
+
+    @struct.dataclass
+    class S:
+        params: dict
+        step: jnp.ndarray
+
+    s = S(params={"w": jnp.ones((3,))}, step=jnp.asarray(6))
+    ck = str(tmp_path / "ck")
+    plane = _plane()
+    plane.advance(6)
+    save_state(ck, 6, s, data_state=plane.snapshot())
+    save_state(ck, 8, s)  # "old-format": no data_state recorded
+
+    fresh = _plane()
+    assert _seek_data_plane(
+        fresh, ckpt_dir=ck, source="checkpoint", step=6,
+        fallback_epoch=1,
+    ) == "exact"
+    assert (fresh.streams["source"].epoch,
+            fresh.streams["source"].cursor) == (1, 2)
+
+    fresh = _plane()
+    with caplog.at_level("WARNING", logger="dwt_tpu.train.loop"):
+        mode = _seek_data_plane(
+            fresh, ckpt_dir=ck, source="checkpoint", step=8,
+            fallback_epoch=2,
+        )
+    assert mode == "epoch_boundary"
+    assert (fresh.streams["source"].epoch,
+            fresh.streams["source"].cursor) == (2, 0)
+    assert any("no usable data_state" in r.message for r in caplog.records)
+
+    fresh = _plane()
+    assert _seek_data_plane(
+        fresh, ckpt_dir=ck, source="memory", step=7,
+        fallback_epoch=0, exact_step=7,
+    ) == "exact_arith"
+    assert (fresh.streams["source"].epoch,
+            fresh.streams["source"].cursor) == (1, 3)
+
+    # Non-step-aligned run (downgraded resume / prior in-memory
+    # recovery): the arithmetic seek would be silently wrong, so a
+    # memory restore takes the honest epoch-boundary fallback instead.
+    fresh = _plane()
+    assert _seek_data_plane(
+        fresh, ckpt_dir=ck, source="memory", step=7,
+        fallback_epoch=1, exact_step=7, arith_ok=False,
+    ) == "epoch_boundary"
+    assert (fresh.streams["source"].epoch,
+            fresh.streams["source"].cursor) == (1, 0)
+
+
+# ------------------------------------------------------------ ckpt_fsck
+
+
+def _cas_tree(tmp_path, steps=(1, 2, 3)):
+    from dwt_tpu.ckpt.store import save_delta
+
+    d = str(tmp_path / "ck")
+    for i, s in enumerate(steps):
+        tree = {"params": {
+            "backbone": np.full((8, 8), 1.0, np.float32),
+            "head": np.full((4,), float(i), np.float32),
+        }}
+        save_delta(d, s, tree,
+                   data_state=_snap() if i == len(steps) - 1 else None)
+    return d
+
+
+def test_fsck_clean_tree_reports_chain_and_data_state(tmp_path):
+    import ckpt_fsck
+
+    d = _cas_tree(tmp_path)
+    report = ckpt_fsck.audit(d)
+    assert report["torn_candidates"] == 0
+    assert [c["chain_depth"] for c in report["candidates"]] == [0, 1, 2]
+    assert [c["data_state"] for c in report["candidates"]] == [
+        False, False, True
+    ]
+    assert ckpt_fsck.main([d]) == 0
+    assert ckpt_fsck.main([d, "--json"]) == 0
+
+
+def test_fsck_flags_torn_chain_nonzero(tmp_path, capsys):
+    """The ROADMAP acceptance: exit nonzero on any torn kept chain,
+    against the same torn-chain construction test_ckpt_store.py uses
+    (a chain-inherited blob vanishes)."""
+    import ckpt_fsck
+
+    from dwt_tpu.ckpt.store import _blob_path, resolve_leaves
+
+    d = _cas_tree(tmp_path)
+    resolved = resolve_leaves(os.path.join(d, "2"))
+    key = next(k for k in resolved.entries if "head" in k)
+    entry, store = resolved.entries[key]
+    os.remove(_blob_path(store, entry["digest"]))
+
+    report = ckpt_fsck.audit(d)
+    assert report["torn_candidates"] == 1
+    assert report["blobs_missing"] == 1
+    torn = [c for c in report["candidates"] if not c["valid"]]
+    assert torn[0]["step"] == 2 and "missing blob" in torn[0]["reason"]
+    assert ckpt_fsck.main([d]) == 1
+    assert "TORN" in capsys.readouterr().out
+
+
+def test_fsck_counts_truncated_blob_as_missing(tmp_path):
+    import ckpt_fsck
+
+    from dwt_tpu.ckpt.store import _blob_path, resolve_leaves
+
+    d = _cas_tree(tmp_path)
+    resolved = resolve_leaves(os.path.join(d, "3"))
+    key = next(k for k in resolved.entries if "backbone" in k)
+    entry, store = resolved.entries[key]
+    blob = _blob_path(store, entry["digest"])
+    with open(blob, "wb") as f:
+        f.write(b"short")  # torn short of entry['nbytes']
+    report = ckpt_fsck.audit(d)
+    assert report["blobs_missing"] == 1  # absent OR truncated, per doc
+    assert report["torn_candidates"] == 3  # every chain reads backbone
+    assert ckpt_fsck.main([d]) == 1
+
+
+def test_fsck_orphan_accounting_and_missing_dir(tmp_path):
+    import ckpt_fsck
+
+    from dwt_tpu.ckpt.store import _blob_path, blob_store_root
+
+    d = _cas_tree(tmp_path)
+    orphan = _blob_path(blob_store_root(d), "ab" + "0" * 62)
+    os.makedirs(os.path.dirname(orphan), exist_ok=True)
+    with open(orphan, "wb") as f:
+        f.write(b"x" * 128)
+    report = ckpt_fsck.audit(d)
+    assert report["blobs_orphaned"] == 1
+    assert report["reclaimable_bytes"] == 128
+    assert ckpt_fsck.main([d]) == 0  # orphans are reclaimable, not torn
+    assert ckpt_fsck.main([str(tmp_path / "nope")]) == 2

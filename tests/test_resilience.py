@@ -1191,10 +1191,11 @@ def test_rollback_falls_back_to_anchor_checkpoint(tmp_path):
             records.append((kind, step, kw))
 
     guard = DivergenceGuard("rollback", interval=1)
-    restored = _rollback_state(
+    restored, src = _rollback_state(
         DigitsConfig(ckpt_dir=ck), _Rec(), guard, anchor_state, 9
     )
     assert int(restored.step) == 4
+    assert src == "anchor"  # the loops re-seek the data plane from it
     kind, step, kw = records[-1]
     assert kind == "rollback" and step == 4 and kw["source"] == "anchor"
 
@@ -1227,10 +1228,11 @@ def test_rollback_prefers_newer_anchor_over_older_main_step(tmp_path):
             records.append((kind, step, kw))
 
     guard = DivergenceGuard("rollback", interval=1)
-    restored = _rollback_state(
+    restored, src = _rollback_state(
         DigitsConfig(ckpt_dir=ck), _Rec(), guard, _tiny_state(), 25
     )
     assert int(restored.step) == 6  # anchor 6, not main-dir step 2
+    assert src == "anchor"
     assert records[-1][2]["source"] == "anchor"
 
 

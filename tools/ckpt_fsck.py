@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Offline checkpoint-tree auditor (the ROADMAP's ``ckpt_fsck``).
+
+Read-only walk over a ``--ckpt_dir`` tree — main steps, ``anchors/``,
+``best_*/`` — reporting, per candidate:
+
+* validity (``checkpoint_invalid_reason`` — the SAME authority the
+  ranked restore walk uses, so "fsck says torn" == "resume will skip");
+* on-disk format, and for ``cas_delta`` manifests the resolved chain
+  depth (manifests a restore must read) and chain base;
+* whether a ``data_state`` (exact mid-epoch resume cursor) is recorded;
+
+plus store-level accounting for the content-addressed blob store:
+
+* **missing** blobs — referenced by some manifest but absent/truncated
+  (each shows up as an invalid candidate too);
+* **orphaned** blobs — referenced by NO manifest (a crashed stage, a
+  GC that hasn't run): their total bytes are the tree's reclaimable
+  space (``gc_blobs`` would sweep them once aged);
+* in-flight ``.tmp-*`` stages (informational — invisible to restore).
+
+Exit codes: 0 = every kept/anchor/best candidate is restorable;
+1 = at least one candidate is torn (its reason printed); 2 = unusable
+input (no such directory).  ``--json`` emits one machine-readable
+record instead of the table.
+
+Usage::
+
+    python tools/ckpt_fsck.py /path/to/ckpt_dir
+    python tools/ckpt_fsck.py /path/to/ckpt_dir --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+from dwt_tpu.ckpt.store import (  # noqa: E402
+    BLOBS_DIR,
+    _blob_path,
+    resolve_leaves,
+)
+from dwt_tpu.utils.checkpoint import (  # noqa: E402
+    ANCHOR_SUBDIR,
+    MANIFEST,
+    _TMP_PREFIX,
+    _read_manifest,
+    checkpoint_invalid_reason,
+)
+
+
+def _candidate_dirs(root: str):
+    """``(label, step_dir)`` for every step candidate under the tree:
+    main-dir digits, anchors/, and best_*/ one level down."""
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if name.isdigit() and os.path.isdir(path):
+            yield "main", path
+        elif name == ANCHOR_SUBDIR and os.path.isdir(path):
+            for sub in sorted(os.listdir(path)):
+                if sub.isdigit():
+                    yield "anchor", os.path.join(path, sub)
+        elif name.startswith("best") and os.path.isdir(path):
+            for sub in sorted(os.listdir(path)):
+                if sub.isdigit():
+                    yield name, os.path.join(path, sub)
+
+
+def _chain_info(step_dir: str, manifest: dict):
+    """(depth, base_step, resolved) for a cas candidate — ONE chain
+    resolution shared with the caller's blob accounting; (None, None,
+    None) if broken (the validity column already carries the reason)."""
+    try:
+        resolved = resolve_leaves(step_dir, manifest)
+    except ValueError:
+        return None, None, None
+    base = _read_manifest(resolved.chain_dirs[-1]) or {}
+    return len(resolved.chain_dirs) - 1, base.get("step"), resolved
+
+
+def audit(ckpt_dir: str) -> dict:
+    """The full read-only audit record (see module doc)."""
+    root = os.path.abspath(os.path.expanduser(ckpt_dir))
+    candidates = []
+    referenced = {}  # digest -> (nbytes, one referencing step_dir)
+    for label, step_dir in _candidate_dirs(root):
+        reason = checkpoint_invalid_reason(step_dir)
+        manifest = _read_manifest(step_dir) or {}
+        fmt = manifest.get("format", "orbax" if manifest else "legacy")
+        rec = {
+            "kind": label,
+            "step": int(os.path.basename(step_dir)),
+            "path": os.path.relpath(step_dir, root),
+            "format": fmt,
+            "valid": reason is None,
+            "reason": reason,
+            "data_state": manifest.get("data_state") is not None,
+        }
+        if fmt == "cas_delta":
+            depth, base, resolved = _chain_info(step_dir, manifest)
+            rec["chain_depth"] = depth
+            rec["chain_base_step"] = base
+            if resolved is not None:
+                for entry, store in resolved.entries.values():
+                    referenced.setdefault(
+                        entry["digest"],
+                        (int(entry["nbytes"]), store),
+                    )
+        candidates.append(rec)
+    # In-flight stages also pin blobs (a staged-but-unpromoted save's
+    # fresh blobs are NOT orphans — gc_blobs counts them too).
+    tmp_stages = []
+    for name in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+        if not name.startswith(_TMP_PREFIX):
+            continue
+        tmp_stages.append(name)
+        manifest = _read_manifest(os.path.join(root, name))
+        if manifest and manifest.get("format") == "cas_delta":
+            try:
+                resolved = resolve_leaves(os.path.join(root, name), manifest)
+                for entry, store in resolved.entries.values():
+                    referenced.setdefault(
+                        entry["digest"], (int(entry["nbytes"]), store)
+                    )
+            except ValueError:
+                pass
+
+    store = os.path.join(root, BLOBS_DIR)
+    on_disk = {}
+    if os.path.isdir(store):
+        for shard in os.listdir(store):
+            sdir = os.path.join(store, shard)
+            if not os.path.isdir(sdir):
+                continue
+            for name in os.listdir(sdir):
+                if name.endswith(".bin"):
+                    try:
+                        on_disk[name[:-4]] = os.path.getsize(
+                            os.path.join(sdir, name)
+                        )
+                    except OSError:
+                        continue
+    def _absent_or_truncated(digest, nbytes, st):
+        try:
+            return os.path.getsize(_blob_path(st, digest)) != int(nbytes)
+        except OSError:
+            return True
+
+    missing = sorted(
+        d for d, (nbytes, st) in referenced.items()
+        if os.path.abspath(st) == os.path.abspath(store)
+        and _absent_or_truncated(d, nbytes, st)
+    )
+    orphaned = sorted(set(on_disk) - set(referenced))
+    return {
+        "kind": "ckpt_fsck",
+        "ckpt_dir": root,
+        "candidates": candidates,
+        "valid_candidates": sum(1 for c in candidates if c["valid"]),
+        "torn_candidates": sum(1 for c in candidates if not c["valid"]),
+        "tmp_stages": tmp_stages,
+        "blobs_on_disk": len(on_disk),
+        "blobs_referenced": len(referenced),
+        "blobs_missing": len(missing),
+        "missing_digests": missing[:16],
+        "blobs_orphaned": len(orphaned),
+        "reclaimable_bytes": int(sum(on_disk[d] for d in orphaned)),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="read-only checkpoint-tree auditor (exit 1 on any "
+                    "torn kept/anchor/best candidate)"
+    )
+    ap.add_argument("ckpt_dir", help="checkpoint tree to audit")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON record instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.ckpt_dir):
+        print(f"ckpt_fsck: {args.ckpt_dir}: not a directory",
+              file=sys.stderr)
+        return 2
+    report = audit(args.ckpt_dir)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"ckpt_fsck: {report['ckpt_dir']}")
+        for c in report["candidates"]:
+            chain = (
+                f" chain_depth={c['chain_depth']}"
+                f" base={c['chain_base_step']}"
+                if c.get("chain_depth") is not None else ""
+            )
+            status = "ok" if c["valid"] else f"TORN ({c['reason']})"
+            ds = "+data_state" if c["data_state"] else "-data_state"
+            print(f"  [{c['kind']:>7}] step {c['step']:>8} "
+                  f"{c['format']:<12} {ds}{chain}  {status}")
+        if report["tmp_stages"]:
+            print(f"  in-flight stages: {', '.join(report['tmp_stages'])}")
+        print(
+            f"  blobs: {report['blobs_on_disk']} on disk, "
+            f"{report['blobs_referenced']} referenced, "
+            f"{report['blobs_missing']} missing, "
+            f"{report['blobs_orphaned']} orphaned "
+            f"({report['reclaimable_bytes']} reclaimable bytes)"
+        )
+        verdict = (
+            "clean" if report["torn_candidates"] == 0
+            else f"{report['torn_candidates']} torn candidate(s)"
+        )
+        print(f"  verdict: {verdict}")
+    return 0 if report["torn_candidates"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

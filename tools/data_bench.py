@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Input-pipeline benchmark: decode throughput vs workers + sampler cost.
+
+Two questions, one JSON record (last line on stdout, the repo's bench
+contract — ``bench.py --phase data`` embeds this module, and
+``tools/obs_diff.py`` extracts every ``data_*``/``sampler_*`` field so
+``bench.py --compare`` gates input throughput like any other metric):
+
+1. **images/s vs ``--workers``** — one full epoch of
+   ``batch_iterator`` over a synthetic dataset whose per-item cost is a
+   ``--decode_ms`` sleep (stands in for PIL/cv2 time, which releases
+   the GIL exactly like the real decoders).  Sweeps the
+   ordered-reassembly pool (``data/pipeline.OrderedWorkerPool``), so
+   the numbers include its window/stall machinery, not an idealized
+   pool.  ``data_w<N>_imgs_per_sec`` per arm; the headline metric
+   ``data_pipeline_imgs_per_sec`` is the best arm.
+2. **seekable-vs-materialized sampler overhead** — the per-epoch index
+   cost of the Feistel ``SeekableSampler`` against
+   ``np.random.permutation`` at ``--sampler_n`` items
+   (``sampler_seekable_ms`` / ``sampler_materialized_ms`` /
+   ``sampler_overhead_pct``), plus ``sampler_seek_ms``: mapping only
+   the last batch of the epoch — the O(remaining) seek a mid-epoch
+   resume actually pays, vs regenerating the whole order.
+
+Usage::
+
+    python tools/data_bench.py
+    python tools/data_bench.py --items 4096 --decode_ms 0.5 --workers 0,2,4,8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+
+class _SyntheticDecode:
+    """Dataset whose item cost is a deterministic sleep + tiny numpy
+    work — the sleep releases the GIL like a real PIL/cv2 decode, so
+    worker scaling here predicts real scaling."""
+
+    def __init__(self, n: int, decode_ms: float):
+        self.n = int(n)
+        self.decode_s = float(decode_ms) / 1e3
+        self._img = np.zeros((32, 32, 3), np.float32)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i: int):
+        if self.decode_s:
+            time.sleep(self.decode_s)
+        return self._img + np.float32(i), np.int64(i % 10)
+
+
+def _epoch_imgs_per_sec(ds, batch: int, workers: int) -> float:
+    from dwt_tpu.data import batch_iterator
+
+    t0 = time.perf_counter()
+    n = 0
+    for b in batch_iterator(ds, batch, shuffle=True, seed=1, epoch=0,
+                            num_workers=workers, substitute=True):
+        n += len(b[1])
+    return n / (time.perf_counter() - t0)
+
+
+def _sampler_costs(n: int, batch: int) -> dict:
+    from dwt_tpu.data import SeekableSampler
+
+    t0 = time.perf_counter()
+    s = SeekableSampler(n, seed=1, epoch=0)
+    s.positions()
+    seekable_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    np.random.default_rng((1, 0)).permutation(n)
+    materialized_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    s.positions(n - batch)  # the mid-epoch seek: only the tail is mapped
+    seek_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "sampler_seekable_ms": round(seekable_ms, 3),
+        "sampler_materialized_ms": round(materialized_ms, 3),
+        "sampler_overhead_pct": round(
+            (seekable_ms - materialized_ms) / materialized_ms * 100.0, 1
+        ) if materialized_ms else 0.0,
+        "sampler_seek_ms": round(seek_ms, 3),
+    }
+
+
+def run(items: int = 2048, batch: int = 32, workers=(0, 2, 4),
+        decode_ms: float = 0.3, sampler_n: int = 1_000_000) -> dict:
+    """The full sweep as one bench-contract record."""
+    ds = _SyntheticDecode(items, decode_ms)
+    record = {
+        "metric": "data_pipeline_imgs_per_sec",
+        "unit": "imgs/sec",
+        "vs_baseline": 1.0,
+        "backend": "host",
+        "items": int(items),
+        "batch": int(batch),
+        "decode_ms": float(decode_ms),
+    }
+    best = 0.0
+    for w in workers:
+        rate = _epoch_imgs_per_sec(ds, batch, int(w))
+        record[f"data_w{int(w)}_imgs_per_sec"] = round(rate, 1)
+        best = max(best, rate)
+        print(f"data_bench: workers={w}: {rate:.1f} imgs/s",
+              file=sys.stderr)
+    record["value"] = round(best, 1)
+    record.update(_sampler_costs(int(sampler_n), batch))
+    record["sampler_n"] = int(sampler_n)
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="input-pipeline bench: imgs/s vs workers + "
+                    "seekable-sampler overhead"
+    )
+    ap.add_argument("--items", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--workers", default="0,2,4",
+                    help="comma-separated worker counts to sweep")
+    ap.add_argument("--decode_ms", type=float, default=0.3,
+                    help="synthetic per-item decode cost (GIL-releasing)")
+    ap.add_argument("--sampler_n", type=int, default=1_000_000,
+                    help="domain size for the sampler-cost comparison")
+    args = ap.parse_args(argv)
+    workers = [int(w) for w in str(args.workers).split(",") if w != ""]
+    record = run(args.items, args.batch, workers, args.decode_ms,
+                 args.sampler_n)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

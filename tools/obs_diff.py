@@ -51,6 +51,9 @@ _DIRECTION_RULES: List[Tuple[str, str]] = [
     (r"(accuracy|mfu)$", "up"),
     (r"speedup", "up"),
     (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
+    # sampler_overhead_pct is deliberately absent: a ratio of two
+    # micro-timings amplifies run-to-run noise past any sane band, so
+    # it is reported (direction unknown) but never gated.
     (r"(_ms|_s)(_p[0-9.]+)?$", "down"),
     (r"(ms_per_step|step_time|stall|latency|duration)", "down"),
 ]
@@ -112,9 +115,15 @@ def _extract_bench(rec: dict, out: Dict[str, float]) -> None:
             out[key] = v
     # --harvest_depth sweep fields (harvest_d<N>_ms_per_step,
     # harvest_record_speedup): the record-path A/B rides the same gate
-    # so the ISSUE-14 trajectory is enforced, not eyeballed.
+    # so the ISSUE-14 trajectory is enforced, not eyeballed.  Same for
+    # the data-plane bench's per-arm fields (data_w<N>_imgs_per_sec,
+    # sampler_*_ms / sampler_overhead_pct): bench.py --phase data
+    # --compare gates input throughput and sampler cost per arm, not
+    # just the headline metric.
     for key, raw in rec.items():
-        if str(key).startswith("harvest_"):
+        if str(key) == "sampler_n":
+            continue  # config constant (sweep domain size), not a metric
+        if str(key).startswith(("harvest_", "data_w", "sampler_")):
             v = _num(raw)
             if v is not None:
                 out[str(key)] = v
