@@ -454,13 +454,20 @@ def _inherited_delta_blobs(resolved: ResolvedChain) -> List[str]:
 
 def promote_delta(
     ckpt_dir: str, step: int, keep: Optional[int] = None,
-    store_root: Optional[str] = None,
+    store_root: Optional[str] = None, gc: bool = True,
 ) -> str:
     """Finalize a staged delta save: validate the chain + every blob,
     atomically rename ``.tmp-cas-<step>`` to ``<step>``, prune
     (chain-aware) and GC unreferenced blobs.  Primary process only, pure
     filesystem.  Idempotent when the step is already promoted (a
-    notice-driven save can coincide with the cadence save)."""
+    notice-driven save can coincide with the cadence save).
+
+    ``gc=False`` prunes without sweeping blobs — the SHARED-store mode
+    (``--blob_store``): this run's view of the store cannot see sibling
+    runs' manifests, so a local sweep could delete a blob only another
+    run references.  Cross-run GC belongs to whoever owns the full run
+    list (``gc_blobs(..., manifest_roots=...)`` — the sweep
+    supervisor)."""
     root = _root(ckpt_dir)
     tmp = os.path.join(root, f"{_CAS_TMP}{int(step)}")
     final = os.path.join(root, str(int(step)))
@@ -487,7 +494,7 @@ def promote_delta(
     # blob store listed) would grow with anchor count on exactly the
     # path the fleet watcher waits on.  Crash-orphaned blobs (a stage
     # that never promoted) get swept by the next pruning save.
-    if keep is not None and prune_checkpoints(root, keep) > 0:
+    if keep is not None and prune_checkpoints(root, keep) > 0 and gc:
         gc_blobs(store)
     plan = inject.current()
     if plan is not None and plan.missing_parent_blob is not None:
@@ -507,6 +514,7 @@ def save_delta(
     keep: Optional[int] = None,
     require_finite: bool = True,
     data_state: Optional[dict] = None,
+    gc: bool = True,
 ) -> Optional[str]:
     """Stage + promote in one call — the synchronous/single-process save
     path.  ``host_state`` is a host-side numpy pytree (``host_fetch``
@@ -532,7 +540,7 @@ def save_delta(
     path: Optional[str] = None
     if staged is not None and primary:
         path = promote_delta(ckpt_dir, step, keep=keep,
-                             store_root=store_root)
+                             store_root=store_root, gc=gc)
     if multihost:
         from jax.experimental import multihost_utils
 
@@ -574,7 +582,8 @@ def _iter_manifest_dirs(root: str):
 
 
 def gc_blobs(store_root: str,
-             min_age_s: float = GC_MIN_AGE_S) -> Tuple[int, int]:
+             min_age_s: float = GC_MIN_AGE_S,
+             manifest_roots: Optional[List[str]] = None) -> Tuple[int, int]:
     """Sweep blobs referenced by no manifest under the store's parent
     directory; returns ``(files_swept, bytes_swept)``.
 
@@ -585,25 +594,39 @@ def gc_blobs(store_root: str,
     never garbage.  ``min_age_s`` additionally protects young blobs
     (a concurrent save may have just reused one without a finalized
     manifest referencing it yet).
+
+    ``manifest_roots`` is the multi-run form (a shared sweep store):
+    the reference set becomes the UNION of manifests under every listed
+    run's checkpoint tree, so a blob referenced by ANY live run —
+    including one another run's chain merely inherits — is never swept.
+    Only the owner of the full root list (the sweep supervisor) may GC
+    a shared store; a single run's view would miss its siblings'
+    references (the per-run save path disables its local GC instead).
     """
     store = os.path.abspath(store_root)
-    root = os.path.dirname(store)
+    roots = (
+        [os.path.abspath(os.path.expanduser(r)) for r in manifest_roots]
+        if manifest_roots is not None
+        else [os.path.dirname(store)]
+    )
     referenced = set()
-    for d in _iter_manifest_dirs(root):
-        manifest = _read_manifest(d)
-        if manifest is None or manifest.get("format") != CAS_FORMAT:
-            continue
-        for entry in manifest.get("leaves", []):
-            referenced.add(entry["digest"])
+    for root in roots:
+        for d in _iter_manifest_dirs(root):
+            manifest = _read_manifest(d)
+            if manifest is None or manifest.get("format") != CAS_FORMAT:
+                continue
+            for entry in manifest.get("leaves", []):
+                referenced.add(entry["digest"])
     if not referenced:
-        # Fail safe: ZERO referencing manifests under the store's parent
+        # Fail safe: ZERO referencing manifests under the given roots
         # means either a fully-abandoned store (delete it by hand) or a
         # store sited away from its manifests (a mis-passed store_root)
         # — sweeping everything in the second case would invalidate
         # every still-valid checkpoint, so refuse rather than guess.
         log.warning(
             "blob GC skipped: no cas manifests found under %s — if this "
-            "store is truly abandoned, remove it manually", root,
+            "store is truly abandoned, remove it manually",
+            ", ".join(roots),
         )
         return 0, 0
     swept = swept_bytes = 0

@@ -143,6 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "delta saves the next save is forced full, "
                         "bounding restore reads and torn-chain blast "
                         "radius")
+    p.add_argument("--blob_store", type=str, default=d.blob_store,
+                   help="delta-format blob store override: a SHARED "
+                        "store path multiple runs (a sweep's pairs) save "
+                        "into, deduping identical leaves (the frozen "
+                        "backbone) across runs; sharing disables this "
+                        "run's local blob GC — cross-run refcounted GC "
+                        "is the sweep supervisor's (dwt-sweep).  Default: "
+                        "<ckpt_dir>/blobs (private, locally GC'd)")
     p.add_argument("--anchor_every", type=int, default=d.anchor_every,
                    help=">0: every N iters also save an anchor checkpoint "
                         "under ckpt_dir/anchors, exempt from any pruning — "

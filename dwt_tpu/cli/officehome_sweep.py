@@ -125,9 +125,15 @@ def main(argv=None) -> float:
         }
 
     def _write_results(**extra):
+        # Atomic AND durable (tmp + fsync + rename): the sweep
+        # supervisor treats this file as the pair's completion record —
+        # an un-fsynced rename surviving a host crash as a zero-byte
+        # file would erase a finished pair's result.
         tmp = args.results_json + ".tmp"
         with open(tmp, "w") as f:
             json.dump(_payload(**extra), f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, args.results_json)
 
     for source, target in pairs:

@@ -123,6 +123,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "delta saves the next save is forced full, "
                         "bounding the manifests a restore must read and "
                         "the blast radius of a torn chain")
+    p.add_argument("--blob_store", type=str, default=d.blob_store,
+                   help="delta-format blob store override: a SHARED "
+                        "store path multiple runs save into, deduping "
+                        "identical leaves across runs; sharing disables "
+                        "this run's local blob GC (cross-run refcounted "
+                        "GC is the sweep supervisor's).  Default: "
+                        "<ckpt_dir>/blobs (private, locally GC'd)")
     p.add_argument("--anchor_every", type=int, default=d.anchor_every,
                    help=">0: every N epochs also save an anchor checkpoint "
                         "under ckpt_dir/anchors, exempt from any pruning — "

@@ -107,6 +107,12 @@ class DigitsConfig:
     # Max delta-chain length before a save is forced full: bounds the
     # manifests a restore reads and the blast radius of a torn chain.
     delta_max_chain: int = 8
+    # Delta-format blob store override: a SHARED store path multiple
+    # runs (a sweep's pairs) save into, deduping identical leaves (the
+    # frozen backbone) across runs.  Sharing disables this run's local
+    # blob GC — cross-run refcounting belongs to the sweep supervisor
+    # (gc_blobs(..., manifest_roots=...)).  None = <ckpt_dir>/blobs.
+    blob_store: Optional[str] = None
     # >0: every N epochs also save an "anchor" checkpoint under
     # ckpt_dir/anchors, exempt from any pruning — bounds rollback distance
     # under repeated divergence.  0 = off.
@@ -226,9 +232,11 @@ class OfficeHomeConfig:
     keep_ckpts: int = 0
     # Background checkpoint pipeline — see DigitsConfig.async_ckpt.
     async_ckpt: bool = True
-    # Checkpoint format + delta-chain cap — see DigitsConfig.ckpt_format.
+    # Checkpoint format + delta-chain cap + shared blob store — see
+    # DigitsConfig.ckpt_format / delta_max_chain / blob_store.
     ckpt_format: str = "full"
     delta_max_chain: int = 8
+    blob_store: Optional[str] = None
     # >0: every N iters also save an anchor checkpoint under
     # ckpt_dir/anchors (never pruned) — see DigitsConfig.anchor_every.
     anchor_every: int = 0

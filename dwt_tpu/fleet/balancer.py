@@ -235,6 +235,10 @@ class Respawner:
     ``spawn_fn``/``clock`` are injectable and ``background=False``
     makes the spawn synchronous (unit tests drive the backoff with a
     fake clock and a fake spawner).
+
+    The budget/backoff arithmetic lives in
+    :class:`~dwt_tpu.fleet.retry.RespawnBudget` — the same policy the
+    sweep control plane applies to training job slots.
     """
 
     def __init__(self, serve_argv: List[str], host: str = "127.0.0.1",
@@ -242,6 +246,8 @@ class Respawner:
                  ready_timeout_s: float = 120.0,
                  spawn_fn=None, clock=time.monotonic,
                  background: bool = True):
+        from dwt_tpu.fleet.retry import RespawnBudget
+
         self.serve_argv = list(serve_argv)
         self.host = host
         self.max_respawns = int(max_respawns)
@@ -252,12 +258,12 @@ class Respawner:
                 rid, argv, h, ready_timeout_s=self.ready_timeout_s
             )
         )
-        self._clock = clock
+        self._budget = RespawnBudget(
+            max_attempts=self.max_respawns, backoff_s=self.backoff_s,
+            clock=clock,
+        )
         self.background = background
-        self._attempts: dict = {}      # rid -> attempts so far
-        self._next_due: dict = {}      # rid -> earliest next attempt
         self._in_progress: set = set()  # rids with a spawn thread live
-        self._exhausted_logged: set = set()
         self._m_respawns = get_registry().counter(
             "dwt_fleet_respawns_total",
             "replica subprocess respawns", labelnames=("rid",),
@@ -273,26 +279,22 @@ class Respawner:
         rid = replica.rid
         if rid in self._in_progress:
             return False
-        attempts = self._attempts.get(rid, 0)
-        if attempts >= self.max_respawns:
-            if rid not in self._exhausted_logged:
-                self._exhausted_logged.add(rid)
+        if self._budget.exhausted(rid):
+            if self._budget.exhausted_first_time(rid):
                 log.error(
                     "fleet: replica %d dead and respawn budget (%d) "
                     "exhausted; slot stays ejected", rid,
                     self.max_respawns,
                 )
             return False
-        now = self._clock()
-        if now < self._next_due.get(rid, 0.0):
+        if not self._budget.ready(rid):
             return False
-        self._attempts[rid] = attempts + 1
-        self._next_due[rid] = now + self.backoff_s * (2 ** attempts)
+        attempt = self._budget.begin(rid)
         if not self.background:
-            return self._spawn_into(replica, attempts + 1)
+            return self._spawn_into(replica, attempt)
         self._in_progress.add(rid)
         threading.Thread(
-            target=self._spawn_into, args=(replica, attempts + 1),
+            target=self._spawn_into, args=(replica, attempt),
             name=f"dwt-fleet-respawn-{rid}", daemon=True,
         ).start()
         return False

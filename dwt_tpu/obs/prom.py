@@ -386,7 +386,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.wfile.write(body.encode())
             return
         try:
-            body = render(self.registry).encode()
+            fn = getattr(self, "render_fn", None)
+            body = (fn() if fn is not None else render(self.registry)).encode()
         except Exception as e:  # a scrape must answer, not die
             log.exception("metrics render failed")
             body = f"# render failed: {type(e).__name__}: {e}\n".encode()
@@ -403,18 +404,26 @@ _EXPORTER: Optional[ThreadingHTTPServer] = None
 
 def start_exporter(port: int, host: str = "127.0.0.1",
                    registry: Optional[MetricsRegistry] = None,
+                   render_fn=None,
                    ) -> ThreadingHTTPServer:
     """Serve ``/metrics`` on a daemon thread (the training CLIs'
     ``--metrics_port``; 0 binds an ephemeral port — read it back from
     the return's ``server_address``).  Idempotent per process: a second
     call returns the running exporter (the two training entry points
-    share one registry, so one scrape surface is correct)."""
+    share one registry, so one scrape surface is correct).
+
+    ``render_fn`` overrides the body production entirely — an
+    aggregator (the sweep supervisor merging per-job expositions via
+    :func:`merge_expositions`) serves something richer than one
+    registry's render; exceptions still answer the scrape with a
+    comment line rather than killing the connection."""
     global _EXPORTER
     with _EXPORTER_LOCK:
         if _EXPORTER is not None:
             return _EXPORTER
         handler = type("Handler", (_MetricsHandler,), {
             "registry": registry or get_registry(),
+            "render_fn": staticmethod(render_fn) if render_fn else None,
         })
         server = ThreadingHTTPServer((host, int(port)), handler)
         server.daemon_threads = True

@@ -412,10 +412,13 @@ class DeltaAsyncCheckpointer(AsyncCheckpointer):
     delta decision costs no extra hashing pass."""
 
     def __init__(self, store_root=None,
-                 delta_max_chain: Optional[int] = None):
+                 delta_max_chain: Optional[int] = None, gc: bool = True):
         super().__init__()
         self._store_root = store_root
         self._delta_max_chain = delta_max_chain
+        # False on a SHARED store (--blob_store): this run cannot see
+        # sibling runs' manifests, so local GC could sweep their blobs.
+        self._gc = gc
 
     def _prepare(self, snapshot: Any) -> Any:
         from dwt_tpu.utils.checkpoint import host_fetch
@@ -434,6 +437,7 @@ class DeltaAsyncCheckpointer(AsyncCheckpointer):
                 if self._delta_max_chain is not None
                 else DEFAULT_DELTA_MAX_CHAIN
             ),
+            gc=self._gc,
             **kwargs,
         )
 
@@ -449,10 +453,11 @@ class MultiHostDeltaAsyncCheckpointer(MultiHostAsyncCheckpointer):
     consistent, and the state being replicated guarantees it does)."""
 
     def __init__(self, gather=None, store_root=None,
-                 delta_max_chain: Optional[int] = None):
+                 delta_max_chain: Optional[int] = None, gc: bool = True):
         super().__init__(gather=gather)
         self._store_root = store_root
         self._delta_max_chain = delta_max_chain
+        self._gc = gc
 
     def _write_target(self, ckpt_dir: str, step: int, host_tree,
                       kwargs: dict) -> bool:
@@ -477,5 +482,5 @@ class MultiHostDeltaAsyncCheckpointer(MultiHostAsyncCheckpointer):
 
         return promote_delta(
             ckpt_dir, step, keep=kwargs.get("keep"),
-            store_root=self._store_root,
+            store_root=self._store_root, gc=self._gc,
         )

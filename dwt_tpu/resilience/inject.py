@@ -49,6 +49,14 @@ production code at exactly the points the real fault would strike:
 * ``wrap_dataset(ds, role)`` — wraps a train dataset in
   :class:`FlakyDataset` when the plan condemns items for that role,
   driving the loader's retry/quarantine path from a subprocess.
+* sweep-supervisor kinds (``dwt_tpu/sweep``):
+  ``maybe_kill_supervisor_at_schedule(n)`` SIGKILLs the supervisor
+  between its journal update and the job spawn (relaunch must
+  reschedule the pid-less entry); ``take_sweep_preempt(tag)`` tells the
+  supervisor to preempt that running job (notice file, then SIGTERM);
+  ``take_sweep_job_fault(tag)`` yields a per-job ``DWT_FAULT_PLAN``
+  (kill-mid-delta-promote) the supervisor injects into that pair's next
+  spawn — a job dying inside a save, under the supervisor's watch.
 * :class:`FlakyDataset` — the in-process form: chosen indices raise for
   the first N accesses (transient I/O) or always (corrupt item), hang
   forever on their first access (``dead_worker_at`` — the pool worker
@@ -166,13 +174,32 @@ class FaultPlan:
     # are never touched) — the newest-valid walk must fall back past the
     # torn chain to the last full save.
     missing_parent_blob: Optional[int] = None
+    # --- sweep-supervisor faults (dwt_tpu/sweep) -----------------------
+    # SIGKILL the sweep SUPERVISOR inside its Nth scheduling event
+    # (1-based), after the journal records the pair as scheduled but
+    # before the job subprocess spawns — the worst-ordered supervisor
+    # death: a relaunch must treat the pid-less "running" entry as
+    # reschedulable, adopt genuinely-running jobs, and finish the matrix.
+    kill_supervisor_at_schedule: Optional[int] = None
+    # Pair tags (e.g. "Art2Clipart") the supervisor preempts — notice
+    # file first, SIGTERM on the next poll — the first time each is
+    # observed running.  Models the scheduler reclaiming a subset of
+    # slots: the job saves-and-exits-0 and its RESUME reschedules free
+    # (no crash charge).  One-shot per tag.
+    sweep_preempt_pairs: Optional[List[str]] = None
+    # Pair tags whose FIRST spawn gets {"kill_mid_delta_promote": true}
+    # injected into its own DWT_FAULT_PLAN env — the job SIGKILLs itself
+    # mid-save; the supervisor must count the crash, respawn within the
+    # budget, and the respawn resumes from the previous finalized step.
+    sweep_job_kill_mid_save: Optional[List[str]] = None
 
     _FIELDS = (
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
         "slow_step_s", "sigterm_at_step", "io_error_saves", "corrupt_items",
         "notice_at_step", "kill_writer_mid_shard", "kill_mid_delta_promote",
         "missing_parent_blob", "dead_worker_at", "slow_item_at",
-        "slow_item_s",
+        "slow_item_s", "kill_supervisor_at_schedule", "sweep_preempt_pairs",
+        "sweep_job_kill_mid_save",
     )
 
     @classmethod
@@ -268,6 +295,35 @@ class FaultPlan:
         kill_writer = _true_or_step("kill_writer_mid_shard")
         kill_promote = _true_or_step("kill_mid_delta_promote")
         missing_blob = _opt_int("missing_parent_blob")
+        kill_supervisor = _opt_int("kill_supervisor_at_schedule")
+
+        def _tag_list(field):
+            """Validate a pair-tag list spec (scalar string allowed)."""
+            value = spec.get(field)
+            if value is None:
+                return None
+            items = value if isinstance(value, list) else [value]
+            if not items:
+                raise ValueError(
+                    f"{ENV_VAR}: {field} must name at least one pair tag "
+                    "— an empty list injects nothing"
+                )
+            tags = []
+            for v in items:
+                if not isinstance(v, str) or not v:
+                    raise ValueError(
+                        f"{ENV_VAR}: {field} entries must be non-empty "
+                        f"pair tags like 'Art2Clipart'; got {v!r}"
+                    )
+                tags.append(v)
+            if len(set(tags)) != len(tags):
+                raise ValueError(
+                    f"{ENV_VAR}: duplicate tags in {field}: {tags}"
+                )
+            return tags
+
+        preempt_pairs = _tag_list("sweep_preempt_pairs")
+        job_kill_mid_save = _tag_list("sweep_job_kill_mid_save")
 
         def _role_items(field):
             """Validate a role→item-index map (corrupt_items and the
@@ -326,6 +382,9 @@ class FaultPlan:
             dead_worker_at=dead_worker,
             slow_item_at=slow_item,
             slow_item_s=float(slow_item_s),
+            kill_supervisor_at_schedule=kill_supervisor,
+            sweep_preempt_pairs=preempt_pairs,
+            sweep_job_kill_mid_save=job_kill_mid_save,
         )
 
     @classmethod
@@ -549,6 +608,51 @@ def maybe_missing_parent_blob(step: int, inherited_blobs: Any) -> None:
         "save inherits no delta-ancestor blobs (a full save or a "
         "chain-base save) — the fault would be a silent no-op"
     )
+
+
+def maybe_kill_supervisor_at_schedule(event: int) -> None:
+    """SIGKILL the sweep supervisor if armed for its ``event``-th
+    scheduling event (1-based).  Called between the journal update that
+    records the pair as scheduled and the job subprocess spawn — the
+    ordering that leaves the journal claiming a job that never started:
+    the relaunched supervisor must reschedule it, not wait on a ghost."""
+    plan = current()
+    if plan is None or plan.kill_supervisor_at_schedule is None:
+        return
+    if int(plan.kill_supervisor_at_schedule) == int(event):
+        plan.kill_supervisor_at_schedule = None  # one-shot (if we survive…)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def take_sweep_preempt(tag: str) -> bool:
+    """True (once per tag) when the supervisor should preempt the
+    running job for ``tag``: deliver its notice file, then SIGTERM on
+    the next poll — the scheduler-reclaims-a-slot fault."""
+    plan = current()
+    if plan is None or not plan.sweep_preempt_pairs:
+        return False
+    if tag not in plan.sweep_preempt_pairs:
+        return False
+    plan.sweep_preempt_pairs = [
+        t for t in plan.sweep_preempt_pairs if t != tag
+    ] or None
+    return True
+
+
+def take_sweep_job_fault(tag: str) -> Optional[Dict[str, Any]]:
+    """The per-job fault plan (a ``DWT_FAULT_PLAN`` JSON object) the
+    supervisor injects into ``tag``'s next spawn, or None.  One-shot per
+    tag: the RESPAWN of a mid-save-killed job must run clean, or the
+    quarantine budget — not the resume — is what the test exercises."""
+    plan = current()
+    if plan is None or not plan.sweep_job_kill_mid_save:
+        return None
+    if tag not in plan.sweep_job_kill_mid_save:
+        return None
+    plan.sweep_job_kill_mid_save = [
+        t for t in plan.sweep_job_kill_mid_save if t != tag
+    ] or None
+    return {"kill_mid_delta_promote": True}
 
 
 def wrap_dataset(dataset: Any, role: str) -> Any:

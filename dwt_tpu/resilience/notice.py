@@ -64,6 +64,22 @@ def reset_injected() -> None:
     _injected = False
 
 
+def post_notice(path: str) -> None:
+    """Deliver a file-based preemption notice: the sender half of the
+    ``--preempt_notice_file`` contract (the sweep supervisor warning a
+    job before its SIGTERM, a scheduler prolog, a test).  Durable write
+    (tmp + fsync + rename): the watcher keys on existence, and a torn
+    zero-byte file appearing briefly then vanishing under a crashed
+    sender would be a notice that un-happens."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("preempt\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class NoticeWatcher:
     """Context manager polling preemption-notice sources (class doc).
 

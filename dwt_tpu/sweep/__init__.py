@@ -1,0 +1,22 @@
+"""Preemptible multi-run sweep control plane (ISSUE-16).
+
+The fleet pattern promoted from serving replicas to TRAINING runs: a
+supervisor (:mod:`~dwt_tpu.sweep.supervisor`) schedules a pair matrix
+as preemptible subprocesses over bounded job slots, journaling every
+decision (:mod:`~dwt_tpu.sweep.journal`) so the supervisor itself may
+die and relaunch — adopting jobs that kept running, rescheduling the
+rest.  All runs share one content-addressed blob store; cross-run GC
+refcounts blobs against the union of every run's manifest chains
+(``gc_blobs(..., manifest_roots=...)``).  ``dwt-sweep``
+(:mod:`~dwt_tpu.sweep.cli`) is the entry point.
+"""
+
+from dwt_tpu.sweep.journal import SweepJournal, decide_adoption
+from dwt_tpu.sweep.supervisor import JobSpec, SweepSupervisor
+
+__all__ = [
+    "JobSpec",
+    "SweepJournal",
+    "SweepSupervisor",
+    "decide_adoption",
+]

@@ -791,11 +791,22 @@ class _CkptPipeline:
             )
         self._delta_max_chain = int(getattr(cfg, "delta_max_chain", 8))
         self._store_root = None
+        # SHARED blob store (--blob_store, the sweep control plane): all
+        # of a sweep's runs save into one store so identical leaves (the
+        # frozen backbone) dedup across runs.  A run sharing a store must
+        # NOT GC it — its own manifests are only a subset of the store's
+        # references; cross-run GC is the supervisor's
+        # (gc_blobs(..., manifest_roots=...)).
+        shared_store = getattr(cfg, "blob_store", None)
+        self._gc_blobs = shared_store is None
         if cfg.ckpt_dir:
             from dwt_tpu.ckpt.store import blob_store_root, tree_bytes
 
             if self._fmt == "delta":
-                self._store_root = blob_store_root(cfg.ckpt_dir)
+                self._store_root = (
+                    os.path.abspath(os.path.expanduser(shared_store))
+                    if shared_store else blob_store_root(cfg.ckpt_dir)
+                )
             # Callback gauge sampled at scrape/heartbeat time: the total
             # on-disk footprint of the checkpoint tree — the observable
             # the delta format exists to shrink.
@@ -827,6 +838,7 @@ class _CkptPipeline:
                 MultiHostDeltaAsyncCheckpointer(
                     gather=gather, store_root=self._store_root,
                     delta_max_chain=self._delta_max_chain,
+                    gc=self._gc_blobs,
                 )
                 if delta else MultiHostAsyncCheckpointer(gather=gather)
             )
@@ -835,6 +847,7 @@ class _CkptPipeline:
                 DeltaAsyncCheckpointer(
                     store_root=self._store_root,
                     delta_max_chain=self._delta_max_chain,
+                    gc=self._gc_blobs,
                 )
                 if delta else AsyncCheckpointer()
             )
@@ -856,7 +869,8 @@ class _CkptPipeline:
             return [
                 save_delta(
                     ckpt_dir, step, host, store_root=self._store_root,
-                    delta_max_chain=self._delta_max_chain, **kwargs,
+                    delta_max_chain=self._delta_max_chain,
+                    gc=self._gc_blobs, **kwargs,
                 )
                 for ckpt_dir, kwargs in targets
             ]

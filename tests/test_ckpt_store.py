@@ -430,6 +430,36 @@ def test_gc_refuses_sweep_with_zero_manifests(tmp_path):
     assert blobs  # untouched
 
 
+def test_gc_multi_root_refcount_unions_sibling_runs(tmp_path):
+    """Cross-run GC (the sweep's shared store): blobs referenced only by
+    a SIBLING run survive as long as that run's manifest root is in the
+    union — and become sweepable the moment it is dropped.  This is
+    exactly why per-job local GC is disabled on shared stores: one
+    run's view cannot see its siblings' references."""
+    store = str(tmp_path / "blobs")
+    run_a = str(tmp_path / "a" / "ck")
+    run_b = str(tmp_path / "b" / "ck")
+    ta, tb = _tree(seed=1), _tree(seed=2)
+    save_delta(run_a, 1, ta, store_root=store)
+    save_delta(run_b, 1, tb, store_root=store)
+    b_blob = _blob_path(
+        store,
+        resolve_leaves(os.path.join(run_b, "1"))
+        .entries["['params']['backbone']['kernel']"][0]["digest"],
+    )
+
+    # Union view: every blob is referenced by SOME run — nothing swept.
+    swept, _ = gc_blobs(store, min_age_s=0, manifest_roots=[run_a, run_b])
+    assert swept == 0 and os.path.exists(b_blob)
+
+    # run_a's view alone (what a job-side GC would see): run_b's unique
+    # blobs look orphaned and are swept — run_b is now torn.
+    swept, _ = gc_blobs(store, min_age_s=0, manifest_roots=[run_a])
+    assert swept >= 1 and not os.path.exists(b_blob)
+    _assert_tree_equal(restore_state(run_a, ta, step=1), ta)
+    assert cas_invalid_reason(os.path.join(run_b, "1")) is not None
+
+
 def test_chain_cap_zero_disables_chaining(tmp_path):
     d = str(tmp_path / "ck")
     t = _tree()

@@ -833,21 +833,31 @@ def test_guard_ladder_persistent_nan_escalates_in_order(tmp_path):
     from dwt_tpu.cli.usps_mnist import main
 
     ck = str(tmp_path / "ck")
-    # Steps 6,7,9 poisoned: 6 engages the backoff rung, 7 strikes while
-    # backed off (escalate: rollback to the epoch-1 checkpoint), 9
-    # strikes during the still-backed-off replay (rollback budget of 1
-    # is spent: halt).  Recovery is set far out so the scale cannot
-    # recover between strikes and blur the ladder order.  The third
-    # strike sits at 9 — not 8 — because the harvested guard (default
-    # --harvest_depth 2) acts on step 7's flag at the step-8 boundary,
-    # so step 8 (and a fault armed there) already ran before the
-    # rollback; a strike the replay can never reach proves nothing.
-    inject.arm(FaultPlan(nan_at_step=[6, 7, 9]))
+    # Steps 6,9,12 poisoned: 6 engages the backoff rung, 9 strikes while
+    # backed off (escalate: rollback to the newest checkpoint), 12
+    # strikes with the rollback budget of 1 spent (halt).  Recovery is
+    # set far out so the scale cannot recover between strikes and blur
+    # the ladder order.  The strikes sit exactly 3 apart because of two
+    # bounds the depth-2 harvest ring imposes on a loaded box:
+    #  - flags for ADJACENT steps can land in one ready-prefix drain,
+    #    and the guard issues ONE verdict per drain batch (the batch
+    #    minimum — the revert it runs cures the whole window), so
+    #    strikes 1 apart can collapse into a single rung;
+    #  - a strike at step k is guaranteed its verdict by boundary k+2
+    #    (dispatching step k+2 overflows the ring and drains
+    #    everything), so a strike at k+3 is always dispatched AFTER the
+    #    previous verdict landed — it can neither co-drain with it nor
+    #    be consumed pre-verdict and fenced inert by the recovery's
+    #    generation bump (a strike the replay can never reach proves
+    #    nothing).
+    # epochs=4 leaves boundaries after step 12 for the final strike's
+    # flag to drain and fire the halt.
+    inject.arm(FaultPlan(nan_at_step=[6, 9, 12]))
     with pytest.raises(DivergenceError, match="rollbacks already spent"):
         main(
             _digits_argv(
                 tmp_path,
-                epochs=3,
+                epochs=4,
                 guard_policy="rollback",
                 guard_interval=1,
                 guard_lr_backoff=0.5,

@@ -1,0 +1,461 @@
+"""Sweep control plane: journal atomicity, relaunch adoption policy,
+crash quarantine, preemption-as-free-reschedule, and the chaos
+acceptance for the whole supervised matrix.
+
+Tier-1 splits two ways:
+
+* **journal + supervisor units** — in-process, with fake jobs
+  (``python -c`` scripts that crash, park, or write a result) standing
+  in for training: scheduling policy is independent of what the job
+  computes, so these run in seconds;
+* **one real-CLI chaos smoke** — a 2-pair synthetic OfficeHome sweep
+  through ``dwt-sweep`` with a preemption injected into one pair:
+  notice → SIGTERM → save-and-exit-0 → free reschedule → both pairs
+  complete.
+
+The composed-fault acceptance (job SIGKILL mid-save + preemption +
+supervisor SIGKILL mid-schedule + concurrent cross-run GC, accuracies
+equal an undisturbed sweep's, ``ckpt_fsck --store`` zero missing) and
+the drain/relaunch case are slow-marked — they run several real
+training subprocesses end to end.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dwt_tpu.resilience import inject
+from dwt_tpu.sweep import journal as jnl
+from dwt_tpu.sweep.cli import make_argv_fn, parse_pairs
+from dwt_tpu.sweep.journal import (
+    SweepJournal,
+    decide_adoption,
+    job_process_alive,
+)
+from dwt_tpu.sweep.supervisor import JobSpec, SweepSupervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The ~45s synthetic OfficeHome pair config every real-CLI sweep case
+# trains per pair: tiny arch, 40 iters (~20s compile + ~20s of real
+# stepping — wide enough that an injected mid-train preemption reliably
+# lands between the first flushed train record and the finish line).
+_TINY_JOB = (
+    "--synthetic", "--synthetic_size", "12", "--arch", "tiny",
+    "--img_crop_size", "32", "--num_classes", "5",
+    "--source_batch_size", "6", "--test_batch_size", "6",
+    "--num_iters", "40", "--check_acc_step", "20",
+    "--stat_collection_passes", "1", "--log_interval", "1",
+    "--group_size", "4", "--ckpt_every_iters", "10",
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    inject.disarm()
+
+
+# ------------------------------------------------------------- journal
+
+
+def test_journal_update_is_atomic_and_durable(tmp_path):
+    path = str(tmp_path / "sweep.json")
+    j = SweepJournal(path)
+    j.ensure_pairs([("A", "B"), ("B", "A")],
+                   lambda tag: str(tmp_path / tag))
+    j.update("A2B", status=jnl.RUNNING, pid=123, attempts=1)
+    # No tmp residue after the atomic replace...
+    assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+    # ...and a fresh load (the relaunch) reads exactly the last update.
+    j2 = SweepJournal.load(path)
+    assert j2.pairs["A2B"]["status"] == jnl.RUNNING
+    assert j2.pairs["A2B"]["pid"] == 123
+    assert j2.pairs["B2A"]["status"] == jnl.PENDING
+
+
+def test_journal_refuses_unreadable_and_stale_matrix(tmp_path):
+    path = str(tmp_path / "sweep.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    with pytest.raises(RuntimeError, match="refusing to overwrite"):
+        SweepJournal.load(path)
+    os.remove(path)
+    j = SweepJournal(path)
+    j.ensure_pairs([("A", "B")], lambda tag: str(tmp_path / tag))
+    j2 = SweepJournal.load(path)
+    with pytest.raises(RuntimeError, match="different --pairs"):
+        j2.ensure_pairs([("X", "Y")], lambda tag: str(tmp_path / tag))
+
+
+def test_adoption_policy_adopt_vs_reschedule(tmp_path):
+    run_dir = str(tmp_path / "A2B")
+    entry = {"status": jnl.RUNNING, "pid": 4242, "run_dir": run_dir}
+
+    def alive_with_token(pid, token):
+        return pid == 4242 and token == run_dir
+
+    assert decide_adoption(entry, alive=alive_with_token) == "adopt"
+    # Dead (or recycled) pid → reschedule.
+    assert decide_adoption(entry, alive=lambda p, t: False) == "reschedule"
+    # Journal-before-spawn death: running with no pid recorded.
+    assert decide_adoption(
+        {"status": jnl.RUNNING, "pid": None, "run_dir": run_dir}
+    ) == "reschedule"
+    # Settled entries are not the relaunch's business.
+    for status in (jnl.PENDING, jnl.DONE, jnl.QUARANTINED):
+        assert decide_adoption({"status": status, "pid": 4242,
+                                "run_dir": run_dir}) == "keep"
+
+
+def test_job_process_alive_checks_cmdline_token(tmp_path):
+    token = str(tmp_path / "some_run_dir")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)", token]
+    )
+    try:
+        # Brief fork→exec race: /proc/<pid>/cmdline shows the child's
+        # argv only once exec lands.  Irrelevant to the real adoption
+        # path (a relaunch inspects jobs spawned long before), so the
+        # test just waits it out.
+        deadline = time.monotonic() + 5
+        while (not job_process_alive(proc.pid, token)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert job_process_alive(proc.pid, token)
+        # A live pid whose cmdline does NOT carry the run dir is pid
+        # reuse, not our job.
+        assert not job_process_alive(proc.pid, "/definitely/not/there")
+    finally:
+        proc.kill()
+        proc.wait()
+    assert not job_process_alive(proc.pid, token)
+
+
+# ------------------------------------------- supervisor with fake jobs
+
+_FAST = dict(poll_interval_s=0.02, backoff_s=0.01)
+
+
+def _ok_job(spec: JobSpec):
+    """Fake training: immediately writes the pair's result."""
+    code = (
+        "import json, sys\n"
+        "json.dump({'pairs': {sys.argv[2]: 1.0}}, open(sys.argv[1], 'w'))\n"
+    )
+    return [sys.executable, "-c", code,
+            spec.result_json, spec.pair_key, spec.run_dir]
+
+
+def _crash_job(spec: JobSpec):
+    return [sys.executable, "-c", "import sys; sys.exit(3)", spec.run_dir]
+
+
+def _preempt_once_job(spec: JobSpec):
+    """First spawn: logs a ``preempt`` record and exits 0 (the loops'
+    save-and-exit contract).  Second spawn: finishes."""
+    code = (
+        "import json, os, sys\n"
+        "run, res, key, metrics = sys.argv[1:5]\n"
+        "marker = os.path.join(run, 'preempted_once')\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close()\n"
+        "    with open(metrics, 'a') as f:\n"
+        "        f.write(json.dumps({'kind': 'preempt', 'step': 1}) + '\\n')\n"
+        "    sys.exit(0)\n"
+        "json.dump({'pairs': {key: 0.5}}, open(res, 'w'))\n"
+    )
+    return [sys.executable, "-c", code, spec.run_dir, spec.result_json,
+            spec.pair_key, spec.metrics_jsonl]
+
+
+def test_supervisor_runs_matrix_over_bounded_slots(tmp_path):
+    sup = SweepSupervisor(
+        [("A", "B"), ("B", "A"), ("A", "C")], str(tmp_path), _ok_job,
+        slots=2, **_FAST,
+    )
+    summary = sup.run()
+    assert summary["completed"] == 3 and not summary["quarantined"]
+    assert summary["pairs"] == {"A->B": 1.0, "B->A": 1.0, "A->C": 1.0}
+    # The journal on disk agrees — it IS the result of record.
+    j = SweepJournal.load(str(tmp_path / jnl.JOURNAL_NAME))
+    assert j.all_settled()
+
+
+def test_supervisor_quarantines_repeated_crasher_matrix_completes(tmp_path):
+    def argv_fn(spec):
+        return _crash_job(spec) if spec.tag == "A2B" else _ok_job(spec)
+
+    sup = SweepSupervisor(
+        [("A", "B"), ("B", "A")], str(tmp_path), argv_fn,
+        slots=2, job_max_respawns=2, **_FAST,
+    )
+    summary = sup.run()
+    # The crasher burned its budget (2 crashes) and was quarantined; the
+    # healthy pair still completed — one bad pair must not sink the sweep.
+    assert list(summary["quarantined"]) == ["A2B"]
+    assert "crash" in summary["quarantined"]["A2B"]
+    assert summary["pairs"] == {"B->A": 1.0}
+    assert summary["respawns"] == {"A2B": 2}
+    entry = sup.journal.pairs["A2B"]
+    assert entry["status"] == jnl.QUARANTINED and entry["crashes"] == 2
+
+
+def test_supervisor_preemption_is_free_reschedule(tmp_path):
+    sup = SweepSupervisor(
+        [("A", "B")], str(tmp_path), _preempt_once_job, slots=1,
+        job_max_respawns=1, **_FAST,
+    )
+    summary = sup.run()
+    # exit 0 + preempt record = free: no crash charged, so even a budget
+    # of 1 survives the reschedule, and the pair completes.
+    assert summary["pairs"] == {"A->B": 0.5}
+    assert summary["preempt_resumes"] == {"A2B": 1}
+    assert summary["respawns"] == {} and not summary["quarantined"]
+
+
+def test_relaunch_adopts_live_job_and_reschedules_dead_one(tmp_path):
+    pairs = [("A", "B"), ("B", "A")]
+    specs = {
+        f"{s}2{t}": JobSpec(s, t, str(tmp_path / f"{s}2{t}"))
+        for s, t in pairs
+    }
+    # Simulate the predecessor supervisor's wake: A2B's job is STILL
+    # RUNNING (a real process, run-dir token on its cmdline, writes its
+    # result then exits); B2A's job died with the predecessor.
+    adopt_spec = specs["A2B"]
+    os.makedirs(adopt_spec.run_dir)
+    code = (
+        "import json, sys, time\n"
+        "json.dump({'pairs': {sys.argv[2]: 0.9}}, open(sys.argv[1], 'w'))\n"
+        "time.sleep(0.4)\n"
+    )
+    orphan = subprocess.Popen(
+        [sys.executable, "-c", code, adopt_spec.result_json,
+         adopt_spec.pair_key, adopt_spec.run_dir]
+    )
+    try:
+        j = SweepJournal(str(tmp_path / jnl.JOURNAL_NAME))
+        j.ensure_pairs(pairs, lambda tag: specs[tag].run_dir)
+        j.update("A2B", status=jnl.RUNNING, pid=orphan.pid, attempts=1)
+        j.update("B2A", status=jnl.RUNNING, pid=None, attempts=1)
+
+        sup = SweepSupervisor(pairs, str(tmp_path), _ok_job, slots=2,
+                              **_FAST)
+        summary = sup.run()
+    finally:
+        if orphan.poll() is None:
+            orphan.kill()
+        orphan.wait()
+    # Adopted job's own result (0.9) survived — it was NOT respawned
+    # (a respawn would have run _ok_job and overwritten with 1.0);
+    # the pid-less entry was rescheduled and completed normally.
+    assert summary["pairs"] == {"A->B": 0.9, "B->A": 1.0}
+    assert not summary["quarantined"]
+
+
+def test_supervisor_stall_detection_kills_wedged_job(tmp_path):
+    def wedged(spec):
+        # Never writes metrics, never exits: the hung-compile shape.
+        return [sys.executable, "-c", "import time; time.sleep(600)",
+                spec.run_dir]
+
+    sup = SweepSupervisor(
+        [("A", "B")], str(tmp_path), wedged, slots=1,
+        job_max_respawns=1, stall_timeout_s=0.3, **_FAST,
+    )
+    t0 = time.monotonic()
+    summary = sup.run()
+    # SIGKILLed for silence, charged as a crash, quarantined on budget
+    # exhaustion — and nowhere near the job's own 600s.
+    assert time.monotonic() - t0 < 60
+    assert list(summary["quarantined"]) == ["A2B"]
+    assert "stalled" in summary["quarantined"]["A2B"]
+
+
+# ------------------------------------------------------------ cli bits
+
+
+def test_parse_pairs_grammar():
+    assert parse_pairs("A,B,C", None) == [
+        ("A", "B"), ("A", "C"), ("B", "A"), ("B", "C"),
+        ("C", "A"), ("C", "B"),
+    ]
+    assert parse_pairs("A,B", "A:B, B:A") == [("A", "B"), ("B", "A")]
+    with pytest.raises(SystemExit):
+        parse_pairs("A,B", "A-B")
+    with pytest.raises(SystemExit):
+        parse_pairs("A,B", "A:B,A:B")
+
+
+def test_argv_fn_owns_plumbing_flags(tmp_path):
+    spec = JobSpec("Art", "Clipart", str(tmp_path / "Art2Clipart"))
+    argv = make_argv_fn(["--synthetic"], str(tmp_path / "blobs"))(spec)
+    assert argv.count("--ckpt_dir") == 1
+    assert spec.result_json in argv and spec.notice_file in argv
+    assert "--blob_store" in argv and "--synthetic" in argv
+
+
+# ----------------------------------------------------- real-CLI chaos
+
+
+def _run_sweep(root, pairs, plan=None, timeout=420, extra=()):
+    """One dwt-sweep subprocess over the tiny synthetic config."""
+    argv = [
+        sys.executable, "-m", "dwt_tpu.sweep.cli",
+        "--sweep_root", str(root), "--pairs", pairs, "--slots", "2",
+        "--poll_interval_s", "0.2", "--job_backoff_s", "0.5",
+        *extra, "--", *_TINY_JOB,
+    ]
+    env = dict(os.environ)
+    env.pop(inject.ENV_VAR, None)
+    if plan is not None:
+        env[inject.ENV_VAR] = json.dumps(plan)
+    proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.fail("sweep supervisor hung — the outcome the control "
+                    "plane exists to prevent")
+    return proc.returncode, out.decode(errors="replace")
+
+
+def _sweep_results(root):
+    with open(os.path.join(str(root), "results.json")) as f:
+        return json.load(f)
+
+
+def test_sweep_smoke_with_injected_preemption(tmp_path):
+    """Tier-1 acceptance smoke: a 2-pair synthetic sweep with one pair
+    preempted mid-run (notice → SIGTERM → save-and-exit-0) completes
+    every pair, records the preemption as a FREE reschedule, and exits
+    0."""
+    rc, out = _run_sweep(
+        tmp_path / "sweep", "Art:Clipart,Clipart:Art",
+        plan={"sweep_preempt_pairs": ["Art2Clipart"]},
+    )
+    assert rc == 0, out
+    res = _sweep_results(tmp_path / "sweep")
+    assert res["completed"] == 2 and not res["quarantined"], out
+    assert set(res["pairs"]) == {"Art->Clipart", "Clipart->Art"}
+    # The preempted pair resumed for free: no crash respawn was charged.
+    assert res["preempt_resumes"] == {"Art2Clipart": 1}, out
+    assert res["respawns"] == {}, out
+    # The preempted job parked through the save-and-exit contract: its
+    # metrics JSONL carries the fsync'd preempt record.
+    m = os.path.join(str(tmp_path / "sweep"), "Art2Clipart",
+                     "metrics.Art2Clipart.jsonl")
+    kinds = [json.loads(l).get("kind") for l in open(m)]
+    assert "preempt" in kinds
+
+
+@pytest.mark.slow
+def test_sweep_composed_chaos_matches_undisturbed_accuracies(tmp_path):
+    """THE acceptance case: one pair's job SIGKILLed mid-save, the other
+    preempted, the supervisor itself SIGKILLed mid-schedule (journal
+    written, spawn not yet issued), cross-run GC sweeping the shared
+    store throughout — the relaunched supervisor adopts/reschedules per
+    journal, every pair completes with accuracies IDENTICAL to an
+    undisturbed sweep, and ``ckpt_fsck --store`` finds zero missing
+    blobs (GC never ate a referenced one)."""
+    gc_args = ("--gc_every_polls", "5", "--gc_min_age_s", "2")
+
+    rc, out = _run_sweep(tmp_path / "calm", "Art:Clipart,Clipart:Art",
+                         extra=gc_args)
+    assert rc == 0, out
+    calm = _sweep_results(tmp_path / "calm")
+    assert calm["completed"] == 2, out
+
+    # Disturbed pass 1: faults armed.  Schedule events 1 and 2 are the
+    # initial spawns; event 3 is the first fault-driven reschedule — the
+    # supervisor dies there with the journal claiming a spawn that never
+    # happened.
+    chaos_root = tmp_path / "chaos"
+    plan = {
+        "sweep_job_kill_mid_save": ["Art2Clipart"],
+        "sweep_preempt_pairs": ["Clipart2Art"],
+        "kill_supervisor_at_schedule": 3,
+    }
+    rc, out1 = _run_sweep(chaos_root, "Art:Clipart,Clipart:Art",
+                          plan=plan, extra=gc_args)
+    assert rc == -signal.SIGKILL, out1
+    journal = SweepJournal.load(
+        os.path.join(str(chaos_root), jnl.JOURNAL_NAME)
+    )
+    assert not journal.all_settled()
+
+    # Relaunch: same command, no faults.  Adopts whatever survived the
+    # dead supervisor, reschedules the rest, finishes the matrix.
+    rc, out2 = _run_sweep(chaos_root, "Art:Clipart,Clipart:Art",
+                          extra=gc_args)
+    assert rc == 0, out1 + out2
+    chaos = _sweep_results(chaos_root)
+    assert chaos["completed"] == 2 and not chaos["quarantined"], out2
+
+    # Exact resume exactness, end to end: the battered sweep's
+    # accuracies equal the calm sweep's, pair for pair.
+    assert chaos["pairs"] == calm["pairs"], (out1, out2)
+
+    # Store audit: every blob any run's manifests reference is present
+    # and whole — concurrent GC swept only garbage.
+    run_trees = [
+        os.path.join(str(chaos_root), tag, "ckpt", tag)
+        for tag in ("Art2Clipart", "Clipart2Art")
+    ]
+    fsck = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_fsck.py"),
+         "--store", os.path.join(str(chaos_root), "blobs"),
+         *run_trees, "--json"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    report = json.loads(fsck.stdout)
+    assert report["blobs_missing"] == 0, fsck.stdout
+    assert report["blobs_on_disk"] > 0
+
+
+@pytest.mark.slow
+def test_sweep_supervisor_drain_and_relaunch(tmp_path):
+    """Supervisor SIGTERM mid-sweep: it warns every job (notice file),
+    waits out their save-and-exit-0, journals the matrix parked, and
+    exits 0; the relaunch completes everything."""
+    root = tmp_path / "sweep"
+    argv = [
+        sys.executable, "-m", "dwt_tpu.sweep.cli",
+        "--sweep_root", str(root), "--pairs", "Art:Clipart,Clipart:Art",
+        "--slots", "2", "--poll_interval_s", "0.2", "--", *_TINY_JOB,
+    ]
+    env = dict(os.environ)
+    env.pop(inject.ENV_VAR, None)
+    proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    # Wait for both jobs to actually train (metrics files appear), then
+    # preempt the SUPERVISOR.
+    deadline = time.monotonic() + 240
+    metrics = [
+        os.path.join(str(root), tag, f"metrics.{tag}.jsonl")
+        for tag in ("Art2Clipart", "Clipart2Art")
+    ]
+    while time.monotonic() < deadline:
+        if all(os.path.exists(m) for m in metrics):
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.2)
+    assert proc.poll() is None, proc.communicate()[0].decode()
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0, out.decode(errors="replace")
+
+    rc, out2 = _run_sweep(root, "Art:Clipart,Clipart:Art")
+    assert rc == 0, out2
+    res = _sweep_results(root)
+    assert res["completed"] == 2 and not res["quarantined"], out2

@@ -36,6 +36,14 @@ base (full) and is reported separately.  Acceptance gate: on
 tiny-resnet, a steady-state delta save writes <= 1/5 the bytes of a
 full save (measured: ~1/360 — the head is that small a slice).
 
+``--shared_store`` (ISSUE-16) measures the SWEEP's storage claim: N
+frozen-backbone runs (``--runs``, same backbone bits, run-distinct
+heads — the pair matrix's shape) checkpointing into one shared CAS
+store versus N private stores.  Content addressing stores the shared
+backbone once regardless of run count; the record's ``sweep_dedup_x``
+is the measured private/shared total-byte ratio (→ ~N for backbone-
+dominated trees).
+
 ``--processes 2`` (ISSUE-5) measures the MULTI-HOST arms on one machine:
 the parent respawns itself as N distributed ranks (loopback
 coordinator, the test harness's env-var convention) and rank 0 prints
@@ -269,6 +277,97 @@ def run_delta_bench(args) -> dict:
             shutil.rmtree(scratch, ignore_errors=True)
 
 
+def _dir_bytes(root: str) -> int:
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                continue
+    return total
+
+
+def run_shared_store_bench(args) -> dict:
+    """The sweep's storage claim, measured: N frozen-backbone runs
+    (same backbone bits, run-distinct heads — the OfficeHome pair
+    matrix's shape, where every pair fine-tunes one pretrained
+    backbone) checkpointing into ONE shared CAS store versus N private
+    stores.  The backbone's blobs are content-addressed, so the shared
+    store holds them once no matter how many runs reference them;
+    ``sweep_dedup_x`` is the measured private/shared byte ratio."""
+    import jax
+
+    from dwt_tpu.ckpt import save_delta
+    from dwt_tpu.utils.checkpoint import host_fetch
+
+    state, _ = build_state(args.model, args.batch)
+    bump, churned = make_frozen_bump(state, args.churn)
+    state = bump(state)  # compile outside the timed region
+    scratch = args.ckpt_dir or tempfile.mkdtemp(prefix="dwt_ckpt_sweep_")
+    shared_store = os.path.join(scratch, "shared_blobs")
+    try:
+        # Run-distinct initial states: run i's head has advanced i extra
+        # steps, the backbone is bitwise-identical across all of them —
+        # distinct fine-tunes of one pretrained trunk.
+        starts = []
+        s = state
+        for _ in range(args.runs):
+            starts.append(s)
+            s = bump(s)
+        jax.block_until_ready(jax.tree.leaves(s))
+
+        def _save_run(s0, ckpt_dir, store_root):
+            s = s0
+            for k in range(args.saves):
+                s = bump(s)
+                save_delta(
+                    ckpt_dir, int(k + 1), host_fetch(s),
+                    store_root=store_root,
+                    # Shared store: local GC off — one run's view cannot
+                    # see sibling references (the sweep supervisor's
+                    # cross-run GC owns reclamation there).
+                    gc=store_root is None,
+                )
+
+        for i, s0 in enumerate(starts):
+            _save_run(s0, os.path.join(scratch, "shared", f"run{i}"),
+                      shared_store)
+        for i, s0 in enumerate(starts):
+            _save_run(s0, os.path.join(scratch, "private", f"run{i}"),
+                      None)  # default: a blobs/ store per run dir
+
+        shared_bytes = _dir_bytes(shared_store)
+        private_bytes = sum(
+            _dir_bytes(os.path.join(scratch, "private", f"run{i}"))
+            for i in range(args.runs)
+        )
+        # Manifests live in the run dirs either way; add the shared
+        # arm's run dirs so both arms count manifest overhead alike.
+        shared_bytes += sum(
+            _dir_bytes(os.path.join(scratch, "shared", f"run{i}"))
+            for i in range(args.runs)
+        )
+        record = {
+            "model": args.model,
+            "mode": "shared_store",
+            "churn": args.churn,
+            "churned_leaves": int(churned),
+            "runs": args.runs,
+            "saves": args.saves,
+            "shared_store_bytes": int(shared_bytes),
+            "private_store_bytes": int(private_bytes),
+            "sweep_dedup_x": round(
+                private_bytes / max(shared_bytes, 1), 2
+            ),
+        }
+        print(json.dumps(record))
+        return record
+    finally:
+        if args.ckpt_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def _spawn_ranks(argv, processes: int) -> int:
     """Parent mode: respawn this script as N loopback-distributed ranks;
     forward rank 0's output (the JSON record)."""
@@ -330,8 +429,19 @@ def main(argv=None):
                         "move between saves in the --delta profile "
                         "(default: the classifier head — params and "
                         "their mirrored optimizer moments)")
+    p.add_argument("--shared_store", action="store_true",
+                   help="bench N frozen-backbone runs checkpointing "
+                        "into ONE shared CAS store vs N private stores "
+                        "(the sweep's storage dedup claim)")
+    p.add_argument("--runs", type=int, default=4,
+                   help="simulated runs in the --shared_store arm")
     args = p.parse_args(argv)
 
+    if args.shared_store:
+        if args.processes > 1:
+            raise SystemExit("--shared_store benches the single-process "
+                             "sync arms; drop --processes")
+        return run_shared_store_bench(args)
     if args.delta:
         if args.processes > 1:
             raise SystemExit("--delta benches the single-process sync "

@@ -49,7 +49,8 @@ from typing import Dict, List, Optional, Tuple
 _DIRECTION_RULES: List[Tuple[str, str]] = [
     (r"(imgs_per_s|imgs_per_sec|steps_per_s|per_sec)", "up"),
     (r"(accuracy|mfu)$", "up"),
-    (r"speedup", "up"),
+    (r"(speedup|reduction_x|dedup_x)", "up"),
+    (r"_bytes$", "down"),
     (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
     # sampler_overhead_pct is deliberately absent: a ratio of two
     # micro-timings amplifies run-to-run noise past any sane band, so
@@ -129,6 +130,25 @@ def _extract_bench(rec: dict, out: Dict[str, float]) -> None:
                 out[str(key)] = v
 
 
+_CKPT_BENCH_KEYS = (
+    # ckpt_bench.py stall arms
+    "sync_save_ms", "async_enqueue_ms", "stall_reduction_x",
+    "async_writer_ms",
+    # --delta arm
+    "full_save_ms", "full_bytes", "delta_save_ms", "delta_bytes",
+    "delta_first_bytes", "bytes_reduction_x",
+    # --shared_store arm (sweep storage dedup)
+    "shared_store_bytes", "private_store_bytes", "sweep_dedup_x",
+)
+
+
+def _extract_ckpt_bench(rec: dict, out: Dict[str, float]) -> None:
+    for key in _CKPT_BENCH_KEYS:
+        v = _num(rec.get(key))
+        if v is not None:
+            out[key] = v
+
+
 def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
     offered = rec.get("offered_imgs_per_s", "?")
     prefix = f"serve@{offered:g}" if isinstance(
@@ -180,6 +200,9 @@ def extract_metrics(records: List[dict]) -> Dict[str, float]:
         kind = rec.get("kind")
         if "metric" in rec and "value" in rec:
             _extract_bench(rec, out)
+        elif "sync_save_ms" in rec or rec.get("mode") in (
+                "delta_vs_full", "shared_store"):
+            _extract_ckpt_bench(rec, out)
         elif kind == "serve_bench":
             _extract_serve_bench(rec, out)
         elif kind == "obs_report":
