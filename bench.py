@@ -83,9 +83,11 @@ _ANALYTIC_TRAIN_FLOPS_PER_IMG = {
 }
 
 
-def _build_lenet(batch: int):
+def _build_lenet(batch: int, dtype=None):
     """Model/state/batch + jitted train step for the digits benchmarks
-    (shared with the --harvest_depth record-path sweep)."""
+    (shared with the --harvest_depth record-path sweep and the
+    --compute_dtype precision sweep; ``dtype`` defaults to the reference
+    recipe's f32)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -93,17 +95,18 @@ def _build_lenet(batch: int):
     from dwt_tpu.nn import LeNetDWT
     from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
 
+    dtype = jnp.float32 if dtype is None else dtype
     rng = np.random.default_rng(0)
     b = {
         "source_x": jnp.asarray(
-            rng.normal(size=(batch, 28, 28, 1)), jnp.float32
+            rng.normal(size=(batch, 28, 28, 1)), dtype
         ),
         "source_y": jnp.asarray(rng.integers(0, 10, size=(batch,))),
         "target_x": jnp.asarray(
-            rng.normal(size=(batch, 28, 28, 1)), jnp.float32
+            rng.normal(size=(batch, 28, 28, 1)), dtype
         ),
     }
-    model = LeNetDWT(group_size=4)
+    model = LeNetDWT(group_size=4, dtype=dtype)
     tx = adam_l2(1e-3, 5e-4)
     state = create_train_state(
         model, jax.random.key(0), jnp.stack([b["source_x"], b["target_x"]]), tx
@@ -152,11 +155,15 @@ def _bench_lenet_eval(steps: int, batch: int):
     return _time_steps(jax.jit(step), state, b, steps, imgs_per_step=batch)
 
 
-def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
+def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None,
+                    dtype=None):
     """Model/state/batch for the flagship benchmarks.  ``tx`` defaults to
     the reference SGD recipe; the eval bench passes ``optax.identity()``
     so no momentum buffers (a full extra param copy in HBM) are
-    allocated for an inference measurement."""
+    allocated for an inference measurement.  ``dtype`` defaults to the
+    reference recipe's bf16 compute — the --compute_dtype sweep passes
+    f32 explicitly to price the bf16 arm against it (the default build
+    IS already the bf16 arm)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -164,21 +171,22 @@ def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
     from dwt_tpu.nn import ResNetDWT
     from dwt_tpu.train import create_train_state, sgd_two_group
 
+    dtype = jnp.bfloat16 if dtype is None else dtype
     rng = np.random.default_rng(0)
     b = {
         "source_x": jnp.asarray(
-            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+            rng.normal(size=(batch, image, image, 3)), dtype
         ),
         "source_y": jnp.asarray(rng.integers(0, 65, size=(batch,))),
         "target_x": jnp.asarray(
-            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+            rng.normal(size=(batch, image, image, 3)), dtype
         ),
         "target_aug_x": jnp.asarray(
-            rng.normal(size=(batch, image, image, 3)), jnp.bfloat16
+            rng.normal(size=(batch, image, image, 3)), dtype
         ),
     }
     model = ResNetDWT.resnet50(
-        num_classes=65, group_size=4, dtype=jnp.bfloat16,
+        num_classes=65, group_size=4, dtype=dtype,
         use_pallas=use_pallas,
     )
     if tx is None:
@@ -189,15 +197,16 @@ def _build_resnet50(batch: int, image: int, use_pallas: bool, tx=None):
 
 
 def _build_resnet50_step(batch: int, image: int = 224,
-                         use_pallas: bool = False):
+                         use_pallas: bool = False, dtype=None):
     """Flagship jitted train step + state/batch — ONE construction site
-    shared by the main bench and the --harvest_depth sweep so the two
-    can never measure divergent step recipes."""
+    shared by the main bench and the --harvest_depth/--compute_dtype
+    sweeps so they can never measure divergent step recipes."""
     import jax
 
     from dwt_tpu.train import make_officehome_train_step
 
-    model, tx, state, b = _build_resnet50(batch, image, use_pallas)
+    model, tx, state, b = _build_resnet50(batch, image, use_pallas,
+                                          dtype=dtype)
     step = jax.jit(
         make_officehome_train_step(model, tx, 0.1), donate_argnums=0
     )
@@ -457,6 +466,67 @@ def _harvest_sweep(args, record):
         )
 
 
+def _compute_dtype_sweep(args, record):
+    """The ``--compute_dtype`` sweep arm: train-step ms/step per listed
+    compute dtype (f32, bf16), stamped into the bench record so
+    ``--compare`` (through tools/obs_diff.py) gates the bf16 frontier
+    instead of eyeballing it.
+
+    Each arm REBUILDS the model at that dtype — the flagship default
+    build is already bf16 (the reference recipe), so an honest f32-vs-bf16
+    price needs both variants constructed explicitly from the same
+    construction site (:func:`_build_resnet50_step` / :func:`_build_lenet`)
+    rather than reusing the headline measurement for either arm.
+    Params and optimizer state stay f32 in BOTH arms (flax param_dtype);
+    only activations/gradients/whitening traffic change dtype — the same
+    contract the training CLIs' --compute_dtype flag enforces.
+    """
+    import jax.numpy as jnp
+
+    names = []
+    for tok in str(args.compute_dtype).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok not in ("f32", "bf16"):
+            raise SystemExit(
+                f"bench: unknown --compute_dtype arm {tok!r} "
+                "(expected f32 and/or bf16)"
+            )
+        names.append(tok)
+    if not names:
+        return
+    times = {}
+    any_degraded = False
+    for name in names:
+        dt = jnp.bfloat16 if name == "bf16" else jnp.float32
+        if args.model == "lenet":
+            step, state, b = _build_lenet(args.batch or 32, dtype=dt)
+        else:
+            step, state, b = _build_resnet50_step(
+                args.batch or 18, args.image, use_pallas=args.pallas,
+                dtype=dt,
+            )
+        step, _ = _compile_with_flops(step, state, b)
+        per_step, state, _, degraded = two_point_per_step(
+            step, state, b, args.steps
+        )
+        times[name] = per_step
+        record[f"compute_{name}_ms_per_step"] = round(per_step * 1e3, 3)
+        if degraded:
+            # Bool marker rides the record without becoming a gated
+            # metric (obs_diff extracts numerics only).
+            record[f"compute_{name}_degraded"] = True
+            any_degraded = True
+    if (
+        "f32" in times and "bf16" in times and times["bf16"] > 0
+        and not any_degraded  # a mixed-methodology ratio gates nothing
+    ):
+        record["bf16_step_speedup"] = round(
+            times["f32"] / times["bf16"], 3
+        )
+
+
 def timing_label(scan_k: int, degraded: bool) -> str:
     """Three-way timing label, shared by bench.py and profile_step.py so
     identically-labeled numbers are measured identically."""
@@ -662,6 +732,12 @@ def _reexec_cpu_fallback(args, diagnosis: str) -> int:
         # The sweep arm rides the fallback too (the record path is a
         # host-side mechanism — its A/B is meaningful on any backend).
         model_args += ["--harvest_depth", args.harvest_depth]
+    if getattr(args, "compute_dtype", None):
+        # The precision sweep rides the fallback too: CPU bf16 is
+        # emulated (the speedup will read ~1x or worse), but the record
+        # keeps its fields so a --compare against a TPU baseline reports
+        # an honest verdict instead of MISSING-by-accident.
+        model_args += ["--compute_dtype", args.compute_dtype]
     if getattr(args, "compare", None):
         # The gate rides the fallback too: a CPU rerun still compares
         # against the baseline (like-for-like metric names make a TPU
@@ -761,6 +837,18 @@ def main():
         "listed) to the record; --compare gates them like any metric",
     )
     ap.add_argument(
+        "--compute_dtype",
+        default=None,
+        metavar="DT0,DT1,...",
+        help="precision sweep arm: also time the train step rebuilt at "
+        "each listed compute dtype ('f32,bf16' for the reduced-precision "
+        "A/B).  Adds compute_<dt>_ms_per_step fields (plus "
+        "bf16_step_speedup when both arms are listed) to the record; "
+        "--compare gates them like any metric.  Params/optimizer state "
+        "stay f32 in every arm — this prices exactly what the training "
+        "CLIs' --compute_dtype flag changes",
+    )
+    ap.add_argument(
         "--no-probe",
         action="store_true",
         help="skip the subprocess backend probe (fallback path)",
@@ -795,6 +883,9 @@ def main():
         ap.error("--pallas is a training-path A/B; use --phase train")
     if args.harvest_depth and args.phase != "train":
         ap.error("--harvest_depth sweeps the TRAIN record path; "
+                 "use --phase train")
+    if args.compute_dtype and args.phase != "train":
+        ap.error("--compute_dtype sweeps the TRAIN step; "
                  "use --phase train")
 
     if args.phase == "data":
@@ -958,6 +1049,8 @@ def main():
         record["fallback"] = args.fallback_note
     if args.harvest_depth:
         _harvest_sweep(args, record)
+    if args.compute_dtype:
+        _compute_dtype_sweep(args, record)
     obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     print(json.dumps(record))
     _maybe_compare(args, record)

@@ -233,7 +233,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "boundary against the live registry; fire/clear "
                         "transitions emit 'alert' JSONL records and the "
                         "dwt_alerts_firing gauge")
-    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--bf16", action="store_true",
+                   help="legacy alias for --compute_dtype bf16")
+    p.add_argument("--compute_dtype", type=str, default=d.compute_dtype,
+                   choices=("f32", "bf16"),
+                   help="training compute dtype: params/optimizer state "
+                        "stay f32; bf16 runs activations, backprop "
+                        "traffic, and the whitening apply in bf16 (see "
+                        "ops/whitening.py precision_policy).  f32 "
+                        "(default) is bitwise the legacy path")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize bottleneck blocks in backward "
                         "(less HBM, ~1/3 more FLOPs) for larger batches")

@@ -214,7 +214,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "evaluated each step boundary against the live "
                         "registry; fire/clear transitions emit 'alert' "
                         "JSONL records and the dwt_alerts_firing gauge")
-    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--bf16", action="store_true",
+                   help="legacy alias for --compute_dtype bf16")
+    p.add_argument("--compute_dtype", type=str, default=d.compute_dtype,
+                   choices=("f32", "bf16"),
+                   help="training compute dtype: params/optimizer state "
+                        "stay f32; bf16 runs activations, backprop "
+                        "traffic, and the whitening apply in bf16 (each "
+                        "whitener backend's precision_policy decides "
+                        "whether its factorization promotes or runs "
+                        "natively — ops/whitening.py).  f32 (default) "
+                        "is bitwise the legacy path")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--expect_accuracy", type=float, default=None,
                    help="repro assertion: exit nonzero unless final target "

@@ -118,6 +118,14 @@ class DigitsConfig:
     # under repeated divergence.  0 = off.
     anchor_every: int = 0
     bf16: bool = False
+    # Training compute dtype ("f32" | "bf16"): params and optimizer state
+    # stay f32 always; "bf16" runs activations, backprop traffic, and the
+    # whitening apply in bf16, with each whitener backend's
+    # precision_policy deciding whether its factorization promotes
+    # (cholesky, swbn) or runs natively bf16 (newton_schulz) — see
+    # ops/whitening.py.  "f32" (default) is bitwise the legacy path.
+    # ``bf16=True`` is the legacy alias for compute_dtype="bf16".
+    compute_dtype: str = "f32"
     # Divergence guard (dwt_tpu.resilience): amortized finite-check on
     # loss/grad-norm every guard_interval steps.  Policies: "none" (off),
     # "halt", "skip_step" (revert to last in-memory good state),
@@ -241,6 +249,8 @@ class OfficeHomeConfig:
     # ckpt_dir/anchors (never pruned) — see DigitsConfig.anchor_every.
     anchor_every: int = 0
     bf16: bool = False
+    # Training compute dtype — see DigitsConfig.compute_dtype.
+    compute_dtype: str = "f32"
     remat: bool = False  # jax.checkpoint per bottleneck (HBM for FLOPs)
     # Divergence guard — see DigitsConfig.guard_policy.
     guard_policy: str = "none"
@@ -263,3 +273,27 @@ class OfficeHomeConfig:
     # metrics_port / alert_rules.
     metrics_port: Optional[int] = None
     alert_rules: Optional[str] = None
+
+
+COMPUTE_DTYPES = ("f32", "bf16")
+
+
+def resolve_compute_dtype(cfg) -> str:
+    """The run's compute dtype name ("f32" | "bf16") from the config.
+
+    ``compute_dtype`` wins; the legacy ``bf16`` boolean is an alias for
+    ``compute_dtype="bf16"`` (the two cannot disagree: ``--bf16`` with an
+    explicit ``--compute_dtype f32`` is a contradiction, rejected here
+    rather than silently picking one).  Kept host-side and string-typed so
+    configs stay JSON-serializable; the loops map it to a jnp dtype at
+    model construction.
+    """
+    name = getattr(cfg, "compute_dtype", "f32") or "f32"
+    if name not in COMPUTE_DTYPES:
+        raise ValueError(
+            f"compute_dtype={name!r}: choose from {COMPUTE_DTYPES}"
+        )
+    if getattr(cfg, "bf16", False):
+        if name == "f32":
+            name = "bf16"
+    return name

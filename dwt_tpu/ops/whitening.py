@@ -268,9 +268,15 @@ def _small_matmul_fn(g: int, dtype):
         return _mm_small_unrolled
     # HIGHEST precision: statistics feeding a whitening transform must
     # not ride the TPU's default bf16 multiply passes (see group_cov).
+    # Under a native-bf16 iterate (NS precision policy) the operands stay
+    # bf16 — half the MXU traffic — while the per-matmul ACCUMULATION is
+    # still f32, cast back at the output.  For f32 inputs both casts are
+    # identities, so the reference path's trace is unchanged.
+    acc_dtype = jnp.promote_types(dtype, jnp.float32)
     return lambda p, q: jnp.matmul(
-        p, q, precision=lax.Precision.HIGHEST, preferred_element_type=dtype
-    )
+        p, q, precision=lax.Precision.HIGHEST,
+        preferred_element_type=acc_dtype,
+    ).astype(dtype)
 
 
 def newton_schulz_inverse_sqrt(
@@ -289,21 +295,32 @@ def newton_schulz_inverse_sqrt(
     (rank-1) shrunk covariance init the reference uses.  Matmuls run at
     HIGHEST precision — statistics feeding a whitening transform must not
     ride the TPU's default bf16 multiply passes (same rule as group_cov).
+
+    The iteration runs in ``a.dtype`` (the NS precision policy hands it
+    bf16 under ``--compute_dtype bf16`` — matmul-only, bf16-friendly),
+    but the trace-normalization ACCUMULATORS are always ≥ f32: the trace
+    sum and its rsqrt are where a [S·G, g, g] stack's dynamic range
+    concentrates, and bf16's 8-bit mantissa would square the conditioning
+    error into every group.  For f32 inputs every cast is an identity —
+    the reference trace is unchanged op-for-op.
     """
     if num_iters is None:
         num_iters = ns_default_iters()
     g = a.shape[-1]
+    acc_dtype = jnp.promote_types(a.dtype, jnp.float32)
     eye = jnp.eye(g, dtype=a.dtype)
-    tr = jnp.trace(a, axis1=-2, axis2=-1)[..., None, None]
-    y = a / tr
+    tr = jnp.trace(
+        a.astype(acc_dtype), axis1=-2, axis2=-1
+    )[..., None, None]
+    y = (a.astype(acc_dtype) / tr).astype(a.dtype)
     z = jnp.broadcast_to(eye, a.shape)
     mm = _small_matmul_fn(g, a.dtype)
     for _ in range(num_iters):
         t = 1.5 * eye - 0.5 * mm(z, y)
         y = mm(y, t)
         z = mm(t, z)
-    # z ≈ (A/tr)^{-1/2}; undo the pre-scaling.
-    return z / jnp.sqrt(tr)
+    # z ≈ (A/tr)^{-1/2}; undo the pre-scaling (f32 rsqrt, cast at the end).
+    return (z.astype(acc_dtype) / jnp.sqrt(tr)).astype(a.dtype)
 
 
 def _block_diag_expand(w: jax.Array) -> jax.Array:
@@ -453,6 +470,20 @@ class Whitener:
     def init_stats(self, num_features: int, group_size: int, dtype=jnp.float32):
         return init_whitening_stats(num_features, group_size, dtype)
 
+    def precision_policy(self, compute_dtype) -> jnp.dtype:
+        """The dtype this backend FACTORIZES in when the surrounding net
+        computes in ``compute_dtype`` (``--compute_dtype bf16``).
+
+        Default: promote to f32 at the site and cast the matrix back —
+        Cholesky's sequential divide/subtract chain and SWBN's
+        multiplicative tracker both amplify bf16 rounding, so they
+        declare "cannot hold bf16".  Backends whose factorization is
+        bf16-safe (Newton–Schulz: matmul-only) override this to run
+        natively.  Under f32 compute every policy returns f32, so the
+        default path's trace is unchanged.
+        """
+        return jnp.promote_types(compute_dtype, jnp.float32)
+
     def train_matrix(
         self, cov: jax.Array, stats, eps: float
     ) -> Tuple[jax.Array, Any]:
@@ -502,6 +533,14 @@ class NewtonSchulzWhitener(Whitener):
 
     def __init__(self, num_iters: Optional[int] = None):
         self.num_iters = num_iters
+
+    def precision_policy(self, compute_dtype) -> jnp.dtype:
+        """NS holds bf16 natively: the iteration is pure batched matmuls
+        (bf16 operands, f32 per-matmul accumulation via
+        ``_small_matmul_fn``) and the trace-normalization accumulators
+        inside :func:`newton_schulz_inverse_sqrt` stay f32 regardless —
+        the two places bf16 range actually bites."""
+        return jnp.dtype(compute_dtype)
 
     def matrix_from_cov(self, cov_shrunk: jax.Array) -> jax.Array:
         return newton_schulz_inverse_sqrt(cov_shrunk, self.num_iters)
@@ -657,10 +696,17 @@ def group_whiten(
             m = lax.pmean(m, axis_name)
         xn = xf - m
         cov = group_cov(xn, num_groups, group_size, axis_name)
-        w, aux = whitener.train_matrix(cov, stats, eps)
-        # Moments/factorization stay f32; the apply matmul runs in the
-        # activation dtype (bf16 nets → bf16 MXU path, f32 accumulation) —
-        # the standard mixed-precision norm recipe.
+        # Per-backend precision policy: the backend declares the dtype it
+        # can hold its factorization in under the net's compute dtype —
+        # NS runs natively bf16 (f32 trace accumulators inside), Cholesky
+        # and SWBN promote to f32 at the site.  The EMA below always
+        # accumulates the f32 moments (m, cov) — reduced precision never
+        # touches the running-statistics contract.
+        fact_dtype = whitener.precision_policy(x.dtype)
+        w, aux = whitener.train_matrix(cov.astype(fact_dtype), stats, eps)
+        # Moments stay f32; the apply matmul runs in the activation
+        # dtype (bf16 nets → bf16 MXU path, f32 accumulation) — the
+        # standard mixed-precision norm recipe.
         y = apply_whitening(xn, w, compute_dtype=x.dtype).astype(x.dtype)
         return y, whitener.update_stats(stats, m, cov, momentum, aux)
     else:

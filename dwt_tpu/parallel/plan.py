@@ -538,17 +538,39 @@ class ShardingPlan:
         if getattr(leaf, "sharding", None) == sharding:
             return leaf
         if jax.process_count() == 1:
-            return jax.device_put(leaf, sharding)
+            return self._check_dtype(leaf, jax.device_put(leaf, sharding))
         if getattr(leaf, "is_fully_addressable", True):
             arr = np.asarray(jax.device_get(leaf))
-            return jax.make_array_from_callback(
-                arr.shape, sharding, lambda idx: arr[idx]
+            return self._check_dtype(
+                leaf,
+                jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx: arr[idx]
+                ),
             )
         # A committed global array on the WRONG sharding (multi-host):
         # device_get cannot assemble it host-side; reshard on device via
         # a jitted identity (an XLA collective — legal here because
         # place() is only reached from lockstep control flow).
-        return reshard_fn(sharding)(leaf)
+        return self._check_dtype(leaf, reshard_fn(sharding)(leaf))
+
+    @staticmethod
+    def _check_dtype(leaf, placed):
+        """Placement must be dtype-preserving: reduced-precision serving
+        hands this path bf16 caches and int8 weight trees, and a host
+        round-trip that silently widened a leaf (numpy coercing a
+        weak-typed scalar, an ml_dtypes fallback) would both double the
+        device footprint the precision work just halved AND desync the
+        AOT bucket executables' input avals.  Metadata compare only —
+        free."""
+        want = getattr(leaf, "dtype", None)
+        got = getattr(placed, "dtype", None)
+        if want is not None and got is not None and want != got:
+            raise TypeError(
+                f"plan placement changed a leaf's dtype {want} -> {got} "
+                "— placement must preserve reduced-precision leaves "
+                "(bf16 cache, int8 weights), never silently upcast"
+            )
+        return placed
 
     def place(self, tree: Any, what: str = "tree") -> Any:
         """Place ``tree`` onto its plan shardings (gspmd), else identity.
@@ -574,8 +596,11 @@ class ShardingPlan:
         if jax.process_count() == 1:
             return jax.device_put(tree, repl)
         return jax.tree.map(
-            lambda a: jax.make_array_from_process_local_data(
-                repl, np.asarray(a)
+            lambda a: self._check_dtype(
+                a,
+                jax.make_array_from_process_local_data(
+                    repl, np.asarray(a)
+                ),
             ),
             tree,
         )

@@ -46,10 +46,12 @@ from dataclasses import dataclass
 from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dwt_tpu import obs
 from dwt_tpu.serve.batcher import DEFAULT_BUCKETS, bucket_for, pad_to_bucket
+from dwt_tpu.serve.quant import dequantize_int8, quantize_int8
 from dwt_tpu.train.evalpipe import make_whiten_cache_fn
 from dwt_tpu.train.steps import make_serve_forward
 from dwt_tpu.utils import restore_newest
@@ -84,12 +86,19 @@ class EngineState(NamedTuple):
     The whole deployment artifact — params, frozen whitening/BN running
     stats, and the whiten cache precomputed from them — travels as ONE
     value, so a swap can never pair new params with an old cache (a torn
-    mixed-generation forward would break the bitwise eval contract)."""
+    mixed-generation forward would break the bitwise eval contract).
+
+    ``scales`` is the int8 deployment format's dequant scale tree (one
+    f32 per-tensor scale per param leaf, ``serve.quant``): None on
+    unquantized engines, and ALWAYS travelling with the int8 params it
+    dequantizes — a swap can no more tear weights from their scales than
+    params from their cache."""
 
     params: Any
     batch_stats: Any
     cache: Any
     version: Version
+    scales: Any = None
 
 
 class ServeEngine:
@@ -122,6 +131,8 @@ class ServeEngine:
         step: Optional[int] = None,
         source: Optional[str] = None,
         digest: Optional[str] = None,
+        quantize: bool = False,
+        cache_dtype=None,
     ):
         if plan is None:
             from dwt_tpu.parallel import ShardingPlan
@@ -160,11 +171,32 @@ class ServeEngine:
         self._cache_fn = make_whiten_cache_fn(
             whitener, whiten_eps, eval_domain
         )
+        # int8 deployment format (serve.quant): params quantize per
+        # generation in build_state (off the dispatcher thread), the
+        # compiled forward dequantizes on device.  The cache_dtype cast
+        # (bf16 serving) happens AFTER the f32 factorization — the cache
+        # is frozen per generation, so the precision is a one-time cost.
+        self.quantize = bool(quantize)
+        self._cache_dtype = (
+            None if cache_dtype is None else jnp.dtype(cache_dtype)
+        )
         self.swap_count = 0
         self._state = self.build_state(
             params, batch_stats, version=Version(step, digest)
         )
         forward = make_serve_forward(model)
+        if self.quantize:
+            base_forward = forward
+
+            def forward(params, batch_stats, cache, x):
+                # params arrives as the {"q", "scale"} bundle (see
+                # _forward_params); dequant runs inside the compiled
+                # program so XLA fuses it into the first consumers and
+                # only int8 weights live in the executable's inputs.
+                deq = dequantize_int8(
+                    params["q"], params["scale"], dtype=jnp.float32
+                )
+                return base_forward(deq, batch_stats, cache, x)
         self._x_sharding = plan.batch_sharding()
         fwd = plan.make_serve_forward(forward)
         self._compiled: Dict[int, object] = {}
@@ -178,7 +210,7 @@ class ServeEngine:
             )
             t0 = time.perf_counter()
             self._compiled[b] = jitted.lower(
-                st.params, st.batch_stats, st.cache, spec
+                self._forward_params(st), st.batch_stats, st.cache, spec
             ).compile()
             self.compile_s[b] = round(time.perf_counter() - t0, 3)
         log.info(
@@ -214,6 +246,15 @@ class ServeEngine:
     def step(self) -> Optional[int]:
         return self._state.version.step
 
+    def _forward_params(self, st: EngineState):
+        """The params argument the compiled bucket forwards take: the
+        raw tree, or (int8 format) the weights+scales bundle — bundled
+        per call from ONE EngineState snapshot, so the pair is always
+        same-generation."""
+        if self.quantize:
+            return {"q": st.params, "scale": st.scales}
+        return st.params
+
     def build_state(
         self, params, batch_stats, *, version: Optional[Version] = None
     ) -> EngineState:
@@ -227,6 +268,20 @@ class ServeEngine:
         with obs.span("build_state", "fleet",
                       version=version.label if version else "fresh"):
             cache = self._cache_fn(batch_stats)
+            if cache and self._cache_dtype is not None:
+                # bf16 serving: the matrices FACTORIZED in f32 (the
+                # cache_fn's numerics are shared with eval and never
+                # change dtype), cast once here — frozen thereafter.
+                cache = jax.tree.map(
+                    lambda a: a.astype(self._cache_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                    cache,
+                )
+            scales = None
+            if self.quantize:
+                # Off-dispatcher by the same contract as the cache
+                # factorization: nothing below touches the live _state.
+                params, scales = quantize_int8(params)
             plan = self._plan
             if plan.mode == "gspmd":
                 placed = plan.place(
@@ -237,12 +292,16 @@ class ServeEngine:
                 params = placed["params"]
                 batch_stats = placed["batch_stats"]
                 cache = placed["whiten_cache"] if cache else cache
+                if scales is not None:
+                    scales = plan.place_replicated(scales)
             else:
                 params = plan.place_replicated(params)
                 batch_stats = plan.place_replicated(batch_stats)
                 cache = plan.place_replicated(cache) if cache else cache
+                if scales is not None:
+                    scales = plan.place_replicated(scales)
         return EngineState(params, batch_stats, cache,
-                           version or Version())
+                           version or Version(), scales)
 
     def build_state_from_tree(
         self, tree: dict, *, version: Optional[Version] = None,
@@ -366,7 +425,8 @@ class ServeEngine:
                 f"(compiled: {self.buckets})"
             )
         st = state if state is not None else self._state
-        return fn(st.params, st.batch_stats, st.cache, x_staged)
+        return fn(self._forward_params(st), st.batch_stats, st.cache,
+                  x_staged)
 
     def infer(self, x: np.ndarray, bucket: Optional[int] = None,
               state: Optional[EngineState] = None) -> np.ndarray:

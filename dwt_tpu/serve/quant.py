@@ -1,0 +1,69 @@
+"""Int8 post-training weight quantization — a serving DEPLOYMENT FORMAT.
+
+The checkpoint on disk never changes: blobs stay f32 (delta/CAS chains
+keep deduping across precision configs, and a quantized artifact can
+always be re-derived).  Quantization happens at :meth:`ServeEngine.build_state`
+time, off the dispatcher thread, producing:
+
+* an int8 params tree (same structure, float leaves -> int8), and
+* a dequant SCALE tree (same structure, one f32 per-tensor scale per
+  leaf) carried on :class:`~dwt_tpu.serve.engine.EngineState` —
+
+so the compiled bucket forward dequantizes ``q * scale`` on device (XLA
+fuses the cast into the first consumer matmul) and a hot swap can never
+pair new int8 weights with old scales: they travel in ONE EngineState.
+
+Symmetric per-tensor quantization: ``scale = max|w| / 127``,
+``q = round(w / scale)``.  Good enough for weight-only int8 on the
+paper's nets (the accuracy check is NOT this module's job — every
+quantized candidate goes through the fleet's :class:`CanaryGate`
+fixture-accuracy gate before taking traffic, and ``PostSwapMonitor``
+rolls back the ones that regress live).  Integer/bool leaves pass
+through untouched with scale 1.
+
+The scale tree is structure-complete (every leaf has one) so it jits as
+a plain pytree argument; non-quantized leaves are recognized at trace
+time by dtype, not by a sentinel value.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+
+def _quantize_leaf(leaf):
+    if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+        return leaf, jnp.ones((), jnp.float32)
+    w = jnp.asarray(leaf, jnp.float32)
+    amax = jnp.max(jnp.abs(w))
+    # All-zero leaf: scale 1 keeps the dequant exact (q is all zeros).
+    scale = jnp.where(amax > 0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def quantize_int8(params: Any) -> Tuple[Any, Any]:
+    """``params -> (int8 tree, f32 per-tensor scale tree)``.
+
+    Pure function of the f32 weights — safe to run off the dispatcher
+    thread (build_state's contract); jitted by the caller if wanted.
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    qs, scales = zip(*(_quantize_leaf(l) for l in leaves)) if leaves else ((), ())
+    return jax.tree.unflatten(treedef, qs), jax.tree.unflatten(treedef, scales)
+
+
+def dequantize_int8(qparams: Any, scales: Any, dtype=jnp.float32) -> Any:
+    """``q * scale`` leaf-wise back to ``dtype`` (int8 leaves only —
+    pass-through leaves come back as-is).  Runs INSIDE the compiled
+    serve forward, so the dequant is device-side and fuses."""
+    return jax.tree.map(
+        lambda q, s: (q.astype(dtype) * s.astype(dtype))
+        if q.dtype == jnp.int8 else q,
+        qparams, scales,
+    )

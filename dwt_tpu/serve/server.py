@@ -670,19 +670,38 @@ def _make_handler(client: ServeClient, draining: threading.Event):
     })
 
 
+def resolve_serve_dtype(args) -> str:
+    """``--serve_dtype`` name ("f32" | "bf16"); the legacy ``--bf16``
+    boolean aliases bf16, and an explicit contradictory pair is refused
+    rather than silently resolved."""
+    name = getattr(args, "serve_dtype", None)
+    if name is None:
+        return "bf16" if getattr(args, "bf16", False) else "f32"
+    if name not in ("f32", "bf16"):
+        raise SystemExit(f"dwt-serve: unknown --serve_dtype {name!r}")
+    return name
+
+
 def build_model(args):
     """Model factory mirroring the training CLIs' constructors — the
     serving process must build the SAME architecture the checkpoint was
-    trained with (params are validated structurally at first forward)."""
+    trained with (params are validated structurally at first forward).
+    ``--serve_dtype`` only changes the COMPUTE dtype of the bucket
+    executables; the param template stays f32, so any checkpoint serves
+    at any precision."""
     import jax.numpy as jnp
 
+    dtype = (
+        jnp.bfloat16 if resolve_serve_dtype(args) == "bf16"
+        else jnp.float32
+    )
     if args.model == "lenet":
         from dwt_tpu.nn import LeNetDWT
 
         model = LeNetDWT(
             group_size=args.group_size,
             whitener=args.whitener,
-            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            dtype=dtype,
         )
         input_shape = (28, 28, 1)
     else:
@@ -697,7 +716,7 @@ def build_model(args):
             num_classes=args.num_classes,
             group_size=args.group_size,
             whitener=args.whitener,
-            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            dtype=dtype,
         )
         input_shape = (args.image_size, args.image_size, 3)
     return model, input_shape
@@ -724,10 +743,19 @@ def build_engine(args) -> ServeEngine:
         data_parallel=args.data_parallel,
     )
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    import jax.numpy as jnp
+
+    precision_kw = dict(
+        quantize=bool(getattr(args, "quantize_int8", False)),
+        cache_dtype=(
+            jnp.bfloat16 if resolve_serve_dtype(args) == "bf16" else None
+        ),
+    )
     if args.ckpt_dir:
         return ServeEngine.from_checkpoint(
             args.ckpt_dir, model, input_shape,
             buckets=buckets, whitener=args.whitener, plan=plan,
+            **precision_kw,
         )
     if not args.init_random:
         raise SystemExit(
@@ -738,6 +766,7 @@ def build_engine(args) -> ServeEngine:
     return ServeEngine(
         model, params, stats, input_shape,
         buckets=buckets, whitener=args.whitener, plan=plan,
+        **precision_kw,
     )
 
 
@@ -763,7 +792,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--whitener",
                    choices=["cholesky", "newton_schulz", "swbn"],
                    default="cholesky")
-    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--bf16", action="store_true",
+                   help="legacy alias for --serve_dtype bf16")
+    p.add_argument("--serve_dtype", choices=["f32", "bf16"], default=None,
+                   help="bucket-executable compute dtype: bf16 runs the "
+                        "deployment forward's activations in bf16 and "
+                        "casts the (f32-factorized) whiten cache to bf16 "
+                        "once per generation.  Params restore f32 from "
+                        "checkpoint blobs either way — the cast happens "
+                        "at placement, never at save.  Default: f32 "
+                        "(or bf16 when --bf16 is set)")
+    p.add_argument("--quantize_int8", action="store_true",
+                   help="int8 deployment format: post-training weight "
+                        "quantization at state-build time (per-tensor "
+                        "symmetric scales carried on EngineState; "
+                        "compiled forwards dequantize on device).  "
+                        "Checkpoints on disk stay f32.  Every candidate "
+                        "still passes the canary gate before taking "
+                        "traffic, and PostSwapMonitor rolls back live "
+                        "regressions")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--buckets", default="1,8,32,128",
                    help="comma-separated AOT batch buckets (ascending)")

@@ -29,7 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from dwt_tpu import obs
-from dwt_tpu.config import DigitsConfig, OfficeHomeConfig
+from dwt_tpu.config import (
+    DigitsConfig,
+    OfficeHomeConfig,
+    resolve_compute_dtype,
+)
 from dwt_tpu.data import (
     ArrayDataset,
     Compose,
@@ -1254,13 +1258,20 @@ def run_digits(cfg: DigitsConfig, logger: Optional[MetricLogger] = None) -> floa
     # would fork the opt-state structure and strand checkpoints across
     # guard configurations.
     tx = with_lr_backoff(adam_l2(schedule, cfg.weight_decay))
+    # --compute_dtype (bf16 legacy-aliased): params/opt state stay f32
+    # (flax keeps param_dtype f32; the model casts at entry), so only the
+    # activation/backprop traffic and the whitening apply change dtype.
+    compute_dtype = (
+        jnp.bfloat16 if resolve_compute_dtype(cfg) == "bf16"
+        else jnp.float32
+    )
 
     def build_model(axis_name=None):
         return LeNetDWT(
             group_size=cfg.group_size,
             momentum=cfg.running_momentum,
             axis_name=axis_name,
-            dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+            dtype=compute_dtype,
             use_pallas=cfg.pallas_whiten,
             whitener=getattr(cfg, "whitener", "cholesky"),
         )
@@ -1829,6 +1840,12 @@ def run_officehome(
         raise ValueError("datasets smaller than one batch")
 
     tx = officehome_tx(cfg)
+    # --compute_dtype — same contract as the digits loop: f32 params/opt
+    # state, reduced-precision activation/backprop traffic only.
+    compute_dtype = (
+        jnp.bfloat16 if resolve_compute_dtype(cfg) == "bf16"
+        else jnp.float32
+    )
 
     def build_model(axis_name=None):
         ctors = {
@@ -1844,7 +1861,7 @@ def run_officehome(
             axis_name=axis_name,
             use_pallas=cfg.pallas_whiten,
             whitener=getattr(cfg, "whitener", "cholesky"),
-            dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+            dtype=compute_dtype,
             remat=cfg.remat,
         )
 

@@ -53,6 +53,27 @@ def with_lr_backoff(tx: optax.GradientTransformation) -> optax.GradientTransform
     return optax.chain(tx, scale_by_backoff())
 
 
+def grads_in_param_dtype(grads, params):
+    """Gradients cast leaf-wise to the parameter dtype before they reach
+    the optimizer chain (the ``--compute_dtype bf16`` moment contract:
+    params and therefore Adam/SGD moments stay f32, so a bf16 gradient
+    leaf must widen BEFORE the moment EMAs, not inside them — optax's
+    ``scale_by_adam``/``trace`` init their state in the update dtype, and
+    a bf16 moment would silently halve the optimizer's precision for the
+    rest of the run).  Implemented as a step-side cast, NOT an extra
+    chain element: a stateless link would still fork the opt-state tuple
+    structure and strand existing checkpoints (see ``officehome_tx``).
+    Under f32 compute every cast is an identity and the traced update is
+    unchanged.
+    """
+    import jax
+
+    return jax.tree.map(
+        lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
+        grads, params,
+    )
+
+
 def _map_backoff_states(opt_state, fn):
     """Rebuild ``opt_state`` with ``fn`` applied to every BackoffScaleState.
 

@@ -25,6 +25,7 @@ from dwt_tpu.ops.losses import (
     softmax_cross_entropy,
 )
 from dwt_tpu.ops.whitening import AxisName
+from dwt_tpu.train.optim import grads_in_param_dtype
 from dwt_tpu.train.state import TrainState
 
 Batch = Dict[str, jax.Array]
@@ -37,6 +38,10 @@ def _apply_grads(
     grads: Any,
     batch_stats: Any,
 ) -> TrainState:
+    # bf16 compute: any reduced-precision gradient leaf widens to the
+    # param dtype (f32) HERE, before the optimizer's moment EMAs — see
+    # optim.grads_in_param_dtype.  Identity under f32 compute.
+    grads = grads_in_param_dtype(grads, state.params)
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return state.replace(

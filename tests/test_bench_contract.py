@@ -24,6 +24,9 @@ def _last_json_line(stdout: str) -> dict:
     return json.loads(lines[-1])
 
 
+@pytest.mark.slow  # 46s full resnet50@96px subprocess bench
+# (t1_budget headroom, PR-17 slow-mark round); the record contract
+# stays tier-1-covered by the lenet eval/data phase tests below
 def test_bench_no_probe_emits_contract_json():
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"

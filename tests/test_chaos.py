@@ -244,6 +244,9 @@ def _read_trail(trail_dir, role):
     ]
 
 
+@pytest.mark.slow  # 61s (t1_budget headroom, PR-17 slow-mark round);
+# the drain/resume contract stays tier-1-covered by the sigterm-drain
+# and kill-mid-promote chaos tests
 def test_chaos_sigterm_mid_epoch_exact_resume(tmp_path):
     """Tentpole acceptance (ISSUE-15): a SIGTERM mid-epoch, then a
     relaunch, replays exactly the remaining batch-id sequence — no

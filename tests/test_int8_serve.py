@@ -1,0 +1,249 @@
+"""Int8 deployment format + reduced-precision serve edge (tier-1).
+
+The int8 path is a SERVING format, not a training one: checkpoints on
+disk stay f32 (asserted here against the CAS manifest's per-leaf
+dtypes), quantization happens at ``build_state`` time, and every
+quantized candidate answers to the same ``CanaryGate`` fixture-accuracy
+gate as any other deploy.  Covered:
+
+* quantize/dequantize round-trip bounds and non-float passthrough;
+* the int8 engine's state carries int8 float-leaves + an f32 scale tree
+  and serves within the argmax band of the f32 engine;
+* the canary ACCEPTS an honest quantized candidate and REFUSES a
+  scale-corrupted one (per-leaf corruption — a uniform rescale of every
+  scale is largely absorbed by the normalization layers and must not be
+  what the test leans on);
+* delta/CAS checkpoint restore into a bf16-cache engine round-trips:
+  blobs f32 on disk, cast at placement, served logits finite and
+  argmax-consistent.
+
+Engine compiles are the cost; one module fixture with a single small
+bucket keeps this inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def int8_setup():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.train import create_train_state
+
+    model = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    f32 = ServeEngine(
+        model, state.params, state.batch_stats, (28, 28, 1), buckets=(8,)
+    )
+    int8 = ServeEngine(
+        model, state.params, state.batch_stats, (28, 28, 1), buckets=(8,),
+        quantize=True,
+    )
+    fixture_x = np.random.default_rng(1).normal(
+        size=(8, 28, 28, 1)
+    ).astype(np.float32)
+    return model, state, f32, int8, fixture_x
+
+
+# ------------------------------------------------------------ quant units
+
+
+def test_quantize_roundtrip_bounds():
+    import jax.numpy as jnp
+
+    from dwt_tpu.serve.quant import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(2)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        "zeros": jnp.zeros((4,), jnp.float32),
+        "step": jnp.asarray(7, jnp.int32),  # non-float passthrough
+    }
+    q, scales = quantize_int8(params)
+    assert q["w"].dtype == jnp.int8
+    assert q["zeros"].dtype == jnp.int8
+    assert q["step"].dtype == jnp.int32  # untouched
+    assert scales["w"].dtype == jnp.float32
+    assert float(scales["zeros"]) == 1.0  # zero-leaf guard
+    deq = dequantize_int8(q, scales)
+    # Per-tensor symmetric: |err| <= scale/2 everywhere.
+    err = np.abs(np.asarray(deq["w"]) - np.asarray(params["w"]))
+    assert float(err.max()) <= float(scales["w"]) / 2 + 1e-7
+    np.testing.assert_array_equal(np.asarray(deq["zeros"]), 0.0)
+    assert int(deq["step"]) == 7
+    # Structure-complete scale tree: same treedef as params.
+    import jax
+
+    assert (jax.tree.structure(scales) == jax.tree.structure(params))
+
+
+# --------------------------------------------------------- engine + state
+
+
+def test_int8_engine_state_dtypes(int8_setup):
+    import jax
+    import jax.numpy as jnp
+
+    _, _, _, int8, _ = int8_setup
+    st = int8.state
+    assert st.scales is not None
+    float_leaves = [
+        l for l in jax.tree.leaves(st.params)
+        if jnp.issubdtype(l.dtype, jnp.integer)
+    ]
+    assert float_leaves, "no quantized leaves in int8 engine state"
+    for leaf in jax.tree.leaves(st.params):
+        assert leaf.dtype == jnp.int8, leaf.dtype
+    for s in jax.tree.leaves(st.scales):
+        assert s.dtype == jnp.float32
+
+
+def test_int8_served_within_argmax_band(int8_setup):
+    """Weight-only int8 on the fixture: finite logits, argmax agreement
+    with the f32 engine within the configured band.  Logit CLOSENESS is
+    deliberately not asserted — per-tensor dequant shifts logits by
+    O(scale) while predictions stay put."""
+    _, _, f32, int8, fixture_x = int8_setup
+    ref = f32.infer(fixture_x, bucket=8)
+    got = int8.infer(fixture_x, bucket=8)
+    assert np.isfinite(got).all()
+    agree = float(
+        (np.argmax(ref, -1) == np.argmax(got, -1)).mean()
+    )
+    assert agree >= 0.75, f"int8 argmax agreement {agree}"
+
+
+# ----------------------------------------------------------- canary gate
+
+
+def test_canary_accepts_honest_quantized_candidate(int8_setup):
+    from dwt_tpu.fleet.canary import CanaryGate
+
+    _, _, f32, int8, fixture_x = int8_setup
+    labels = np.argmax(f32.infer(fixture_x, bucket=8), -1)
+    gate = CanaryGate(int8, fixture_x, labels, max_regress_pp=26.0)
+    verdict = gate.check(int8.state)
+    assert verdict.ok, verdict.reason
+
+
+def test_canary_refuses_scale_corrupted_candidate(int8_setup):
+    """A quantized candidate whose scale tree is corrupted PER LEAF
+    (each leaf rescaled by a different factor, signs flipped) collapses
+    fixture accuracy and must be refused before taking traffic.
+
+    A uniform corruption (every scale x57) is NOT used on purpose: the
+    whitening/BN layers renormalize activations per layer, so a uniform
+    per-layer weight rescale largely survives argmax — the gate would
+    pass and the test would prove nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.fleet.canary import CanaryGate
+
+    _, _, f32, int8, fixture_x = int8_setup
+    labels = np.argmax(f32.infer(fixture_x, bucket=8), -1)
+    gate = CanaryGate(int8, fixture_x, labels, max_regress_pp=5.0)
+    assert gate.check(int8.state).ok  # baseline: honest state passes
+
+    st = int8.state
+    leaves, treedef = jax.tree.flatten(st.scales)
+    crng = np.random.default_rng(3)
+    bad = jax.tree.unflatten(
+        treedef,
+        [l * jnp.asarray(float(crng.uniform(-40.0, 40.0)), jnp.float32)
+         for l in leaves],
+    )
+    verdict = gate.check(st._replace(scales=bad))
+    assert not verdict.ok
+    assert "accuracy" in verdict.reason or "finite" in verdict.reason
+    # Refusal means the live state never changed: serving still healthy.
+    assert np.isfinite(int8.infer(fixture_x, bucket=8)).all()
+
+
+# ------------------------------------- delta/CAS restore into bf16 engine
+
+
+def test_cas_restore_into_bf16_engine_roundtrip(tmp_path, int8_setup):
+    """f32 delta/CAS checkpoint -> bf16-cache engine: the cast happens
+    at placement (engine build), never at save — asserted against the
+    manifest's per-leaf dtypes — and the restored engine's served
+    argmax matches the source f32 engine's."""
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.ckpt.store import save_delta
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.utils.checkpoint import host_fetch
+
+    model, state, f32, _, fixture_x = int8_setup
+    ck = str(tmp_path / "ck")
+    path = save_delta(ck, 11, host_fetch(state))
+    assert path is not None
+
+    # On-disk blobs are f32: the manifest records every leaf's dtype.
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    float_entries = [
+        e for e in manifest["leaves"] if "float" in e["dtype"]
+    ]
+    assert float_entries
+    for e in float_entries:
+        assert e["dtype"] == "float32", (e["path"], e["dtype"])
+
+    restored = ServeEngine.from_checkpoint(
+        ck, model, (28, 28, 1), buckets=(8,), cache_dtype=jnp.bfloat16,
+    )
+    cache_leaves = jax.tree.leaves(restored.state.cache)
+    assert cache_leaves
+    for leaf in cache_leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16, leaf.dtype
+    # Params were NOT down-cast — placement preserves the f32 blobs.
+    for leaf in jax.tree.leaves(restored.state.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    got = restored.infer(fixture_x, bucket=8)
+    assert np.isfinite(got).all()
+    ref = f32.infer(fixture_x, bucket=8)
+    agree = float((np.argmax(ref, -1) == np.argmax(got, -1)).mean())
+    assert agree >= 0.75, f"bf16-cache argmax agreement {agree}"
+
+
+def test_cas_restore_quantized_engine(tmp_path, int8_setup):
+    """The full deployment stack composes: f32 CAS checkpoint restored
+    into an int8-weight engine — quantization is derived at build time,
+    the artifact on disk never changes."""
+    import jax
+    import jax.numpy as jnp
+
+    from dwt_tpu.ckpt.store import save_delta
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.utils.checkpoint import host_fetch
+
+    model, state, f32, _, fixture_x = int8_setup
+    ck = str(tmp_path / "ck")
+    assert save_delta(ck, 3, host_fetch(state)) is not None
+    restored = ServeEngine.from_checkpoint(
+        ck, model, (28, 28, 1), buckets=(8,), quantize=True,
+    )
+    assert restored.state.scales is not None
+    for leaf in jax.tree.leaves(restored.state.params):
+        assert leaf.dtype == jnp.int8
+    got = restored.infer(fixture_x, bucket=8)
+    ref = f32.infer(fixture_x, bucket=8)
+    agree = float((np.argmax(ref, -1) == np.argmax(got, -1)).mean())
+    assert agree >= 0.75, f"restored-int8 argmax agreement {agree}"

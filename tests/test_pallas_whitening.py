@@ -328,3 +328,132 @@ def test_newton_schulz_lowers_for_tpu_offchip(monkeypatch):
         jax.ShapeDtypeStruct((rows, c), jnp.float32),
     )
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+# ---------------------------------------- reduced-precision lowering pins
+
+# --compute_dtype bf16 / --serve_dtype bf16 change WHICH programs the
+# flagship runs (bf16 activation/gradient traffic, f32 params; bf16
+# serve buckets reading a bf16 whiten cache) — so the off-chip Mosaic/
+# XLA lowering pins above must cover the bf16 step too, or the reduced-
+# precision path only ever compiles on a real chip.
+
+
+def _abstract_tree(tree):
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+    )
+
+
+def test_bf16_digits_train_step_lowers_for_tpu_offchip():
+    """The --compute_dtype bf16 digits train step (bf16 activations,
+    f32 params/optimizer state — asserted on the abstract state) exports
+    for TPU off-chip at the reference 32+32 batch."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import (
+        adam_l2,
+        create_train_state,
+        make_digits_train_step,
+    )
+
+    model = LeNetDWT(group_size=4, dtype=jnp.bfloat16)
+    tx = adam_l2(1e-3, 5e-4)
+    state = jax.eval_shape(
+        lambda x: create_train_state(model, jax.random.key(0), x, tx),
+        jax.ShapeDtypeStruct((2, 32, 28, 28, 1), jnp.bfloat16),
+    )
+    for leaf in jax.tree.leaves(state.params):
+        assert leaf.dtype == jnp.float32  # flax param_dtype contract
+    batch = {
+        "source_x": jax.ShapeDtypeStruct((32, 28, 28, 1), jnp.bfloat16),
+        "source_y": jax.ShapeDtypeStruct((32,), jnp.int32),
+        "target_x": jax.ShapeDtypeStruct((32, 28, 28, 1), jnp.bfloat16),
+    }
+    step = jax.jit(make_digits_train_step(model, tx, 0.1))
+    exp = export.export(step, platforms=("tpu",))(state, batch)
+    m = exp.mlir_module()
+    assert "bf16" in m and "dot_general" in m
+
+
+def test_bf16_flagship_train_step_lowers_for_tpu_offchip():
+    """The flagship ResNet50-DWT step at the reference recipe (18-image
+    domain streams, 224px, bf16 compute) exports for TPU off-chip —
+    the program ``bench.py --compute_dtype``'s bf16 arm times on chip.
+    State/batch are abstract (``jax.eval_shape``): the pin costs one
+    trace + lowering, no 224px init on the CPU test host."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+    from dwt_tpu.nn import ResNetDWT
+    from dwt_tpu.train import (
+        create_train_state,
+        make_officehome_train_step,
+        sgd_two_group,
+    )
+
+    model = ResNetDWT.resnet50(
+        num_classes=65, group_size=4, dtype=jnp.bfloat16
+    )
+    tx = sgd_two_group(1e-2, 1e-3)
+    state = jax.eval_shape(
+        lambda x: create_train_state(model, jax.random.key(0), x, tx),
+        jax.ShapeDtypeStruct((3, 18, 224, 224, 3), jnp.bfloat16),
+    )
+    batch = {
+        "source_x": jax.ShapeDtypeStruct((18, 224, 224, 3), jnp.bfloat16),
+        "source_y": jax.ShapeDtypeStruct((18,), jnp.int32),
+        "target_x": jax.ShapeDtypeStruct((18, 224, 224, 3), jnp.bfloat16),
+        "target_aug_x": jax.ShapeDtypeStruct(
+            (18, 224, 224, 3), jnp.bfloat16
+        ),
+    }
+    step = jax.jit(make_officehome_train_step(model, tx, 0.1))
+    exp = export.export(step, platforms=("tpu",))(state, batch)
+    m = exp.mlir_module()
+    assert "bf16" in m and "dot_general" in m
+
+
+def test_bf16_serve_bucket_lowers_for_tpu_offchip():
+    """The bf16 serve-bucket executable (--serve_dtype bf16: bf16 model
+    compute + bf16 whiten cache, f32 params — the exact operand dtypes
+    ``ServeEngine.build_state`` places) exports for TPU off-chip at a
+    flagship bucket shape."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import create_train_state, make_serve_forward
+    from dwt_tpu.train.evalpipe import make_whiten_cache_fn
+
+    model = LeNetDWT(group_size=4, dtype=jnp.bfloat16)
+    state = jax.eval_shape(
+        lambda x: create_train_state(
+            model, jax.random.key(0), x, optax.identity()
+        ),
+        jax.ShapeDtypeStruct((2, 8, 28, 28, 1), jnp.bfloat16),
+    )
+    cache = jax.eval_shape(
+        make_whiten_cache_fn("cholesky"), state.batch_stats
+    )
+    cache_bf16 = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        cache,
+    )
+    fwd = jax.jit(make_serve_forward(model))
+    exp = export.export(fwd, platforms=("tpu",))(
+        _abstract_tree(state.params),
+        _abstract_tree(state.batch_stats),
+        cache_bf16,
+        jax.ShapeDtypeStruct((8, 28, 28, 1), jnp.float32),
+    )
+    m = exp.mlir_module()
+    assert "bf16" in m and "dot_general" in m

@@ -1,0 +1,308 @@
+"""Reduced-precision frontier tests (bf16 end-to-end training).
+
+Tier-1 (fast): the per-backend ``precision_policy`` contract, NS
+native-bf16 factorization staying close to its f32 reference, bf16
+``group_whiten`` through every backend (f32 EMA stats preserved), the
+step-side grad cast, and the ``--compute_dtype`` config resolution
+(including the legacy ``--bf16`` alias).
+
+Slow-marked (tools/t1_budget.py discipline): the CLI-level proofs —
+``--compute_dtype f32`` is BITWISE the default run (digits + tiny
+officehome params digests) and ``--compute_dtype bf16`` lands in the
+accuracy band per whitener backend (NS factorizes natively in bf16;
+Cholesky/SWBN promote at the site).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dwt_tpu.ops.whitening import (
+    WHITENER_NAMES,
+    _shrink,
+    get_whitener,
+    group_whiten,
+    newton_schulz_inverse_sqrt,
+)
+
+# ------------------------------------------------------- precision policy
+
+
+def test_precision_policy_promotes_by_default():
+    """Cholesky and SWBN cannot hold bf16: their policy promotes to f32
+    at the site (so a bf16 net's factorization is bitwise the f32
+    net's); NS declares the compute dtype itself — it factorizes
+    natively in bf16."""
+    for name in ("cholesky", "swbn"):
+        wh = get_whitener(name)
+        assert wh.precision_policy(jnp.bfloat16) == jnp.float32
+        assert wh.precision_policy(jnp.float32) == jnp.float32
+    ns = get_whitener("newton_schulz")
+    assert ns.precision_policy(jnp.bfloat16) == jnp.bfloat16
+    assert ns.precision_policy(jnp.float32) == jnp.float32
+
+
+def test_newton_schulz_bf16_native_close_to_f32():
+    """The bf16 NS factorization (bf16 iterate, f32 trace-normalization
+    accumulators) stays within bf16 round-off of the f32 reference and
+    keeps the compute dtype end to end."""
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(16, 4, 4))
+    spd = jnp.asarray(
+        a @ a.transpose(0, 2, 1) + 4 * np.eye(4), jnp.float32
+    )
+    spd = _shrink(spd, 1e-3)
+    w32 = newton_schulz_inverse_sqrt(spd, 5)
+    w16 = newton_schulz_inverse_sqrt(spd.astype(jnp.bfloat16), 5)
+    assert w16.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(w16).all())
+    # bf16 has ~3 decimal digits; the iterate is contractive so errors
+    # do not amplify — a 5% relative band is loose but meaningful.
+    ref = np.asarray(w32)
+    got = np.asarray(w16, np.float32)
+    rel = np.abs(got - ref) / (np.abs(ref) + 1e-2)
+    assert float(rel.max()) < 0.05, float(rel.max())
+
+
+def test_newton_schulz_f32_path_unchanged_by_bf16_support():
+    """The f32 path's casts are identities: same-dtype astype is a
+    traced no-op, so adding bf16 support must not perturb f32 numerics.
+    Pinned against a direct dtype check + determinism (the golden npz
+    in test_whitener_backends pins the absolute numbers)."""
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(8, 4, 4))
+    spd = _shrink(
+        jnp.asarray(a @ a.transpose(0, 2, 1) + 4 * np.eye(4), jnp.float32),
+        1e-3,
+    )
+    w1 = newton_schulz_inverse_sqrt(spd, 5)
+    w2 = newton_schulz_inverse_sqrt(spd, 5)
+    assert w1.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+
+
+@pytest.mark.parametrize("name", WHITENER_NAMES)
+def test_group_whiten_bf16_every_backend(name):
+    """bf16 activations through every backend: finite bf16 output, f32
+    running stats (the EMA contract — reduced precision never touches
+    the running statistics), and train-matrix numerics that actually
+    whiten (output covariance near identity)."""
+    wh = get_whitener(name)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(512, 8)), jnp.bfloat16)
+    stats = wh.init_stats(8, 4)
+    y, new_stats = group_whiten(
+        x, stats, group_size=4, train=True, whitener=name
+    )
+    assert y.dtype == jnp.bfloat16
+    assert y.shape == x.shape
+    assert bool(jnp.isfinite(y).all())
+    assert new_stats.mean.dtype == jnp.float32
+    assert new_stats.cov.dtype == jnp.float32
+    if name != "swbn":  # SWBN converges over steps, not in one batch
+        yf = np.asarray(y, np.float32).reshape(512, 2, 4)
+        for gi in range(2):
+            cov = np.cov(yf[:, gi, :], rowvar=False, bias=True)
+            np.testing.assert_allclose(
+                cov, np.eye(4), atol=0.1,
+                err_msg=f"{name} group {gi} not whitened under bf16",
+            )
+
+
+def test_group_whiten_bf16_cholesky_matches_promoted_f32():
+    """The promote policy's guarantee, concretely: a bf16 batch through
+    Cholesky produces the SAME factorization as whitening the f32 cast
+    of that batch (the only differences are the input rounding and the
+    final cast back — the factorization itself runs f32 either way)."""
+    rng = np.random.default_rng(3)
+    xb = jnp.asarray(rng.normal(size=(256, 8)), jnp.bfloat16)
+    wh = get_whitener("cholesky")
+    stats = wh.init_stats(8, 4)
+    _, st_bf = group_whiten(xb, stats, group_size=4, train=True)
+    _, st_f32 = group_whiten(
+        xb.astype(jnp.float32), stats, group_size=4, train=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_bf.cov), np.asarray(st_f32.cov)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_bf.mean), np.asarray(st_f32.mean)
+    )
+
+
+# ------------------------------------------------------- train-side casts
+
+
+def test_grads_in_param_dtype_casts_to_param_dtype():
+    from dwt_tpu.train.optim import grads_in_param_dtype
+
+    params = {"w": jnp.zeros((3,), jnp.float32),
+              "b": jnp.zeros((2,), jnp.float32)}
+    grads = {"w": jnp.ones((3,), jnp.bfloat16),
+             "b": jnp.ones((2,), jnp.float32)}
+    out = grads_in_param_dtype(grads, params)
+    assert out["w"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_bf16_train_step_keeps_f32_params_and_opt_state():
+    """One digits train step at model dtype bf16: params, grads-applied
+    params, and optimizer moments all stay f32 (flax param_dtype + the
+    step-side grad cast) — the 'params and optimizer state stay f32'
+    half of the --compute_dtype contract."""
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.train import adam_l2, create_train_state
+    from dwt_tpu.train.steps import make_digits_train_step
+
+    model = LeNetDWT(group_size=4, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(4)
+    batch = {
+        "source_x": jnp.asarray(
+            rng.normal(size=(8, 28, 28, 1)), jnp.bfloat16
+        ),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(8,))),
+        "target_x": jnp.asarray(
+            rng.normal(size=(8, 28, 28, 1)), jnp.bfloat16
+        ),
+    }
+    tx = adam_l2(1e-3)
+    state = create_train_state(
+        model, jax.random.key(0),
+        jnp.stack([batch["source_x"], batch["target_x"]]), tx,
+    )
+    step = jax.jit(make_digits_train_step(model, tx))
+    new_state, metrics = step(state, batch)
+    for leaf in jax.tree.leaves(new_state.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves(new_state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ):
+            assert leaf.dtype == jnp.float32
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# ------------------------------------------------------ config resolution
+
+
+def test_resolve_compute_dtype_default_and_alias():
+    from dwt_tpu.config import DigitsConfig, resolve_compute_dtype
+
+    assert resolve_compute_dtype(DigitsConfig()) == "f32"
+    assert resolve_compute_dtype(
+        DigitsConfig(compute_dtype="bf16")
+    ) == "bf16"
+    # Legacy --bf16 alias maps onto the unified knob.
+    assert resolve_compute_dtype(DigitsConfig(bf16=True)) == "bf16"
+    with pytest.raises(ValueError, match="compute_dtype"):
+        resolve_compute_dtype(DigitsConfig(compute_dtype="fp8"))
+
+
+def test_cli_exposes_compute_dtype_flag():
+    """Both CLIs accept --compute_dtype and thread it into the config
+    (config_from_args filters by dataclass fields, so presence in both
+    proves the wiring end to end without running a training job)."""
+    from dwt_tpu.cli import officehome, usps_mnist
+
+    for mod in (usps_mnist, officehome):
+        args = mod.build_parser().parse_args(["--compute_dtype", "bf16"])
+        cfg = mod.config_from_args(args)
+        assert cfg.compute_dtype == "bf16"
+
+
+# ------------------------------------------------------- CLI-level proofs
+
+
+def _run_digits(tmp_path, tag, extra):
+    from dwt_tpu.cli.usps_mnist import main
+
+    jsonl = tmp_path / f"{tag}.jsonl"
+    acc = main([
+        "--synthetic", "--synthetic_size", "32",
+        "--source_batch_size", "8", "--target_batch_size", "8",
+        "--test_batch_size", "16", "--group_size", "4",
+        "--epochs", "2", "--log_interval", "100",
+        "--metrics_jsonl", str(jsonl),
+    ] + extra)
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    digest = [
+        r for r in records if r["kind"] == "params_digest"
+    ][-1]["digest"]
+    return acc, digest, records
+
+
+@pytest.mark.slow
+def test_digits_cli_compute_dtype_f32_bitwise_default(tmp_path):
+    """--compute_dtype f32 IS the default path: identical final params
+    digest — the flag must be a no-op at default precision (acceptance:
+    f32 digests bitwise-identical to the pre-flag CLI)."""
+    acc0, digest0, _ = _run_digits(tmp_path, "default", [])
+    acc1, digest1, _ = _run_digits(
+        tmp_path, "f32", ["--compute_dtype", "f32"]
+    )
+    assert digest0 == digest1
+    assert acc0 == acc1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", WHITENER_NAMES)
+def test_digits_cli_bf16_band_per_backend(tmp_path, name):
+    """End-of-run accuracy under --compute_dtype bf16 stays within the
+    synthetic band of the f32 run, per whitener backend (NS factorizes
+    natively in bf16 — the arm that actually exercises reduced-precision
+    factorization numerics)."""
+    acc_f32, _, _ = _run_digits(
+        tmp_path, f"f32_{name}", ["--whitener", name]
+    )
+    acc_bf16, _, _ = _run_digits(
+        tmp_path, f"bf16_{name}",
+        ["--whitener", name, "--compute_dtype", "bf16"],
+    )
+    # 32-sample synthetic test set quantizes accuracy at 3.125 %/item;
+    # same convention as the backend-parity bands.
+    assert abs(acc_f32 - acc_bf16) <= 12.5, (name, acc_f32, acc_bf16)
+
+
+def _run_officehome(tmp_path, tag, extra):
+    from dwt_tpu.cli.officehome import main
+
+    jsonl = tmp_path / f"{tag}.jsonl"
+    acc = main([
+        "--synthetic", "--synthetic_size", "24", "--arch", "tiny",
+        "--source_batch_size", "4", "--test_batch_size", "8",
+        "--num_iters", "4", "--check_acc_step", "4",
+        "--group_size", "4", "--log_interval", "100",
+        "--metrics_jsonl", str(jsonl),
+    ] + extra)
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    digests = [r for r in records if r["kind"] == "params_digest"]
+    digest = digests[-1]["digest"] if digests else None
+    return acc, digest
+
+
+@pytest.mark.slow
+def test_officehome_cli_compute_dtype_f32_bitwise_default(tmp_path):
+    acc0, digest0 = _run_officehome(tmp_path, "default", [])
+    acc1, digest1 = _run_officehome(
+        tmp_path, "f32", ["--compute_dtype", "f32"]
+    )
+    assert digest0 == digest1
+    assert acc0 == acc1
+
+
+@pytest.mark.slow
+def test_officehome_cli_bf16_band(tmp_path):
+    acc_f32, _ = _run_officehome(tmp_path, "f32", [])
+    acc_bf16, _ = _run_officehome(
+        tmp_path, "bf16", ["--compute_dtype", "bf16"]
+    )
+    # 12-sample synthetic test set quantizes at ~8.3 %/item.
+    assert abs(acc_f32 - acc_bf16) <= 25.0, (acc_f32, acc_bf16)

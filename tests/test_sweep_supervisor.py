@@ -333,6 +333,9 @@ def _sweep_results(root):
         return json.load(f)
 
 
+@pytest.mark.slow  # 187s: heaviest tier-1 test (t1_budget headroom,
+# PR-17 slow-mark round) — the preemption path keeps subprocess
+# coverage via the faster supervisor unit tests above
 def test_sweep_smoke_with_injected_preemption(tmp_path):
     """Tier-1 acceptance smoke: a 2-pair synthetic sweep with one pair
     preempted mid-run (notice → SIGTERM → save-and-exit-0) completes

@@ -20,7 +20,11 @@ object, concatenated objects, or JSONL; every record found is merged):
 * **obs_report --json** (``"kind": "obs_report"``) → per-process loop
   ms/step plus each phase's self-time ms/step, serving per-bucket p99s;
 * **serve_bench JSONL** (``"kind": "serve_bench"``) → per-offered-load
-  achieved rate, latency percentiles, shed rate.
+  achieved rate, latency percentiles, shed rate (plus bf16/int8
+  precision-arm fields when the run served a reduced-precision engine);
+* **whitener_bench JSONL** (``"kind": "whitener_bench"``) → per-backend
+  factorization/train/eval timings and the ``--compute_dtype`` bf16
+  A/B ratios, namespaced ``whitener_<backend>_*``.
 
 Every extracted metric has a DIRECTION (higher-better: throughput,
 accuracy, MFU; lower-better: times, percentiles, shed/error rates) and a
@@ -50,6 +54,10 @@ _DIRECTION_RULES: List[Tuple[str, str]] = [
     (r"(imgs_per_s|imgs_per_sec|steps_per_s|per_sec)", "up"),
     (r"(accuracy|mfu)$", "up"),
     (r"(speedup|reduction_x|dedup_x)", "up"),
+    # Reduced-precision A/Bs: whitener_bf16_x_<backend> is the
+    # bf16-over-f32 throughput ratio of one whitener backend (higher =
+    # bf16 buys more), from tools/whitener_bench.py --compute_dtype.
+    (r"_bf16_x", "up"),
     (r"_bytes$", "down"),
     (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
     # sampler_overhead_pct is deliberately absent: a ratio of two
@@ -124,7 +132,14 @@ def _extract_bench(rec: dict, out: Dict[str, float]) -> None:
     for key, raw in rec.items():
         if str(key) == "sampler_n":
             continue  # config constant (sweep domain size), not a metric
-        if str(key).startswith(("harvest_", "data_w", "sampler_")):
+        if str(key).startswith(("harvest_", "data_w", "sampler_",
+                                # reduced-precision sweep arms:
+                                # compute_{f32,bf16}_ms_per_step,
+                                # bf16_step_speedup (bench.py
+                                # --compute_dtype) and per-backend
+                                # whitener_bf16_x_* / whitener_*_ms
+                                # (tools/whitener_bench.py)
+                                "compute_", "bf16_", "whitener_")):
             v = _num(raw)
             if v is not None:
                 out[str(key)] = v
@@ -149,6 +164,31 @@ def _extract_ckpt_bench(rec: dict, out: Dict[str, float]) -> None:
             out[key] = v
 
 
+_WHITENER_BENCH_KEYS = (
+    "factorize_per_site_chain_ms", "factorize_per_site_dispatch_ms",
+    "factorize_site_stacked_ms", "stacked_speedup",
+    "stacked_vs_dispatch_speedup", "train_step_ms",
+    "eval_pass_ms", "eval_imgs_per_s",
+    # reduced-precision A/B arms (--compute_dtype f32,bf16)
+    "factorize_bf16_stacked_ms", "factorize_bf16_x",
+    "train_step_bf16_ms", "train_bf16_x",
+)
+
+
+def _extract_whitener_bench(rec: dict, out: Dict[str, float]) -> None:
+    """tools/whitener_bench.py JSONL: one record per backend, metrics
+    namespaced ``whitener_<backend>_<key>`` so the three backends' rows
+    coexist in one gate (and the ``_bf16_x`` ratios pick up their
+    higher-is-better direction rule)."""
+    name = rec.get("whitener")
+    if not name:
+        return
+    for key in _WHITENER_BENCH_KEYS:
+        v = _num(rec.get(key))
+        if v is not None:
+            out[f"whitener_{name}_{key}"] = v
+
+
 def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
     offered = rec.get("offered_imgs_per_s", "?")
     prefix = f"serve@{offered:g}" if isinstance(
@@ -157,7 +197,14 @@ def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
                 "e2e_ms_p50", "e2e_ms_p95", "e2e_ms_p99",
                 "queue_ms_p50", "queue_ms_p99",
                 "device_ms_p50", "device_ms_p99",
-                "swap_e2e_ms_p99", "steady_e2e_ms_p99"):
+                "swap_e2e_ms_p99", "steady_e2e_ms_p99",
+                # reduced-precision serve arms (present when the run was
+                # taken with --serve_dtype bf16 / --quantize_int8): the
+                # same record keys, re-published under a precision tag so
+                # an f32 baseline and a bf16/int8 run can coexist in one
+                # JSONL and gate independently.
+                "bf16_imgs_per_sec", "int8_imgs_per_sec",
+                "bf16_e2e_ms_p99", "int8_e2e_ms_p99"):
         v = _num(rec.get(key))
         if v is not None:
             out[f"{prefix}.{key}"] = v
@@ -205,6 +252,8 @@ def extract_metrics(records: List[dict]) -> Dict[str, float]:
             _extract_ckpt_bench(rec, out)
         elif kind == "serve_bench":
             _extract_serve_bench(rec, out)
+        elif kind == "whitener_bench":
+            _extract_whitener_bench(rec, out)
         elif kind == "obs_report":
             _extract_obs_report(rec, out)
         # Unrecognized records (heartbeats, access lines riding a mixed

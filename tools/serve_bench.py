@@ -24,6 +24,15 @@ batcher+engine path the server wraps).  CPU numbers are a functional
 floor; the chip round re-runs this against the TPU roofline (PERF.md
 "Serving path").
 
+Reduced-precision curves ride the inherited server flags: a sweep run
+with ``--serve_dtype bf16`` and/or ``--quantize_int8`` measures the
+bf16-bucket / int8-weight engine (the same ``build_engine`` path
+``dwt-serve`` uses) and RE-publishes the headline numbers under
+precision-tagged keys (``bf16_imgs_per_sec``, ``int8_imgs_per_sec``,
+``*_e2e_ms_p99``) plus a ``precision`` field — so an f32 baseline JSONL
+and a reduced-precision run coexist in one ``tools/obs_diff.py`` gate
+without the per-load keys colliding.
+
 ``--reload_every N`` (with ``--ckpt_dir``) hot-swaps the newest
 checkpoint every N seconds DURING each load — the continuous-deployment
 fleet's restore → build → canary → atomic-swap path under traffic —
@@ -254,6 +263,16 @@ def main(argv=None) -> int:
     for _ in range(args.warmup_requests):
         client.infer(warm)
 
+    # Precision tags for the reduced-precision curves (PERF.md "Serving
+    # path"): both can be set at once (int8 weights + bf16 cache/model).
+    from dwt_tpu.serve.server import resolve_serve_dtype
+
+    tags = []
+    if getattr(args, "quantize_int8", False):
+        tags.append("int8")
+    if resolve_serve_dtype(args) == "bf16":
+        tags.append("bf16")
+
     rc = 0
     try:
         for offered in (float(v) for v in args.loads.split(",")):
@@ -263,6 +282,15 @@ def main(argv=None) -> int:
                 reloader=reloader, reload_every_s=args.reload_every,
                 swap_window_s=args.swap_window_s,
             )
+            if tags:
+                record["precision"] = "+".join(tags)
+                for tag in tags:
+                    if "achieved_imgs_per_s" in record:
+                        record[f"{tag}_imgs_per_sec"] = (
+                            record["achieved_imgs_per_s"]
+                        )
+                    if "e2e_ms_p99" in record:
+                        record[f"{tag}_e2e_ms_p99"] = record["e2e_ms_p99"]
             print(json.dumps(record), flush=True)
     finally:
         client.close(drain=True)

@@ -1,6 +1,6 @@
 """Whitener-backend microbench: factorization, train step, eval pass.
 
-Three measurements per ``--whitener`` backend (PERF.md "Whitener numerics"):
+Three measurements per backend (PERF.md "Whitener numerics"):
 
 * **factorization** at the ResNet50-DWT site inventory (stem + all of
   stage 1): the per-site chain (S sequential ``[G, g, g]`` factorizations,
@@ -13,10 +13,19 @@ Three measurements per ``--whitener`` backend (PERF.md "Whitener numerics"):
 * **eval pass**: ``EvalPipeline.evaluate`` end-to-end on a synthetic
   dataset (includes the once-per-pass cache precompute).
 
-On CPU these are plumbing-honest numbers (no MXU); the JSON marks the
-backend.  Usage::
+``--compute_dtype f32,bf16`` adds the per-backend reduced-precision A/B:
+the site-stacked factorization re-timed at the backend's
+``precision_policy(bf16)`` dtype (NS runs natively bf16; Cholesky/SWBN
+promote to f32, so their ratio prices the promote-and-cast-back policy,
+honestly ~1x) and the LeNet train step rebuilt at model dtype bf16.
+Ratios land as ``factorize_bf16_x`` / ``train_bf16_x`` record fields;
+``tools/obs_diff.py`` extracts them per backend
+(``whitener_<name>_*``) so cross-run comparisons gate the bf16 frontier.
 
-    JAX_PLATFORMS=cpu python tools/whitener_bench.py
+On CPU these are plumbing-honest numbers (no MXU, bf16 emulated — expect
+~1x ratios); the JSON marks the backend.  Usage::
+
+    JAX_PLATFORMS=cpu python tools/whitener_bench.py --compute_dtype f32,bf16
 """
 
 import argparse
@@ -60,7 +69,20 @@ def main():
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--eval_size", type=int, default=512,
                     help="synthetic eval dataset size")
+    ap.add_argument("--compute_dtype", default="f32",
+                    metavar="DT0[,DT1]",
+                    help="'f32,bf16' adds the per-backend bf16-vs-f32 "
+                         "A/B (factorization at the backend's "
+                         "precision_policy dtype + bf16-model train "
+                         "step); default f32 only")
     args = ap.parse_args()
+    dtypes = [t.strip() for t in str(args.compute_dtype).split(",")
+              if t.strip()]
+    for t in dtypes:
+        if t not in ("f32", "bf16"):
+            raise SystemExit(f"whitener_bench: unknown --compute_dtype "
+                             f"arm {t!r} (expected f32 and/or bf16)")
+    bf16_ab = "bf16" in dtypes
 
     import jax
     import jax.numpy as jnp
@@ -89,6 +111,7 @@ def main():
     for name in WHITENER_NAMES:
         wh = get_whitener(name)
         record = {
+            "kind": "whitener_bench",
             "whitener": name,
             "backend": backend,
             "sites": len(RESNET50_SITE_GROUPS),
@@ -119,6 +142,23 @@ def main():
             record["stacked_vs_dispatch_speedup"] = round(
                 dispatch_ms / max(stacked_ms, 1e-9), 2
             )
+            if bf16_ab:
+                # The reduced-precision arm: the cov arrives f32 from
+                # group_cov; under a bf16 model, group_whiten casts it to
+                # the backend's precision_policy(bf16) before
+                # factorizing.  Time exactly that — NS factorizes
+                # natively in bf16, Cholesky/SWBN promote (the cast is
+                # the whole cost of the promote policy).
+                fact_dtype = wh.precision_policy(jnp.bfloat16)
+                bf16_fn = jax.jit(
+                    lambda c: wh.matrix_from_cov(c.astype(fact_dtype))
+                )
+                bf16_ms = _time(bf16_fn, stacked, steps=args.steps) * 1e3
+                record["factorize_bf16_stacked_ms"] = round(bf16_ms, 4)
+                record["bf16_fact_dtype"] = str(jnp.dtype(fact_dtype))
+                record["factorize_bf16_x"] = round(
+                    stacked_ms / max(bf16_ms, 1e-9), 2
+                )
         else:
             record["factorize_per_site_chain_ms"] = None  # no factorization
 
@@ -142,6 +182,26 @@ def main():
             _time(lambda b: step(state, b)[1], batch,
                   steps=max(5, args.steps // 5)) * 1e3, 3
         )
+
+        if bf16_ab:
+            # Full-step A/B at model dtype bf16 (covers SWBN too, which
+            # has no closed-form factorization to A/B above).  Params
+            # stay f32 (flax param_dtype) — same contract as the CLIs'
+            # --compute_dtype bf16.
+            model_bf = LeNetDWT(group_size=4, whitener=name,
+                                dtype=jnp.bfloat16)
+            state_bf = create_train_state(
+                model_bf, jax.random.key(0), sample, tx
+            )
+            step_bf = jax.jit(make_digits_train_step(model_bf, tx))
+            record["train_step_bf16_ms"] = round(
+                _time(lambda b: step_bf(state_bf, b)[1], batch,
+                      steps=max(5, args.steps // 5)) * 1e3, 3
+            )
+            record["train_bf16_x"] = round(
+                record["train_step_ms"]
+                / max(record["train_step_bf16_ms"], 1e-9), 2
+            )
 
         # Eval pass end-to-end (incl. once-per-pass cache precompute).
         from dwt_tpu.data import ArrayDataset
