@@ -17,7 +17,7 @@ re-admission, SIGTERM → drain every replica → exit 0.
 """
 
 from dwt_tpu.fleet.canary import CanaryGate, CanaryVerdict, PostSwapMonitor
-from dwt_tpu.fleet.reload import HotReloader
+from dwt_tpu.fleet.reload import DeployController, HotReloader
 from dwt_tpu.fleet.watcher import Candidate, CheckpointWatcher
 
 __all__ = [
@@ -26,5 +26,6 @@ __all__ = [
     "CanaryGate",
     "CanaryVerdict",
     "PostSwapMonitor",
+    "DeployController",
     "HotReloader",
 ]

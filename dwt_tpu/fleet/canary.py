@@ -208,6 +208,7 @@ class PostSwapMonitor:
         self._clock = clock
         self._armed = False
         self._version: Optional[str] = None
+        self._origin = "reload"
         self._baseline_p99: Optional[float] = None
         self._t_swap: Optional[float] = None
 
@@ -215,16 +216,32 @@ class PostSwapMonitor:
     def armed(self) -> bool:
         return self._armed
 
+    @property
+    def armed_version(self) -> Optional[str]:
+        return self._version
+
+    @property
+    def armed_origin(self) -> str:
+        """Which deploy path armed this watch: ``"reload"`` (checkpoint
+        hot reload) or ``"adapt"`` (online-adaptation generation).  The
+        shared deploy controller routes the rollback CONSEQUENCE by it —
+        a regressed checkpoint gets blacklisted, a regressed adapted
+        generation additionally freezes the adapter."""
+        return self._origin
+
     def arm(self, version: str,
-            baseline_p99: Optional[float] = None) -> None:
+            baseline_p99: Optional[float] = None,
+            origin: str = "reload") -> None:
         self._armed = True
         self._version = str(version)
         self._baseline_p99 = baseline_p99
+        self._origin = str(origin)
         self._t_swap = self._clock()
 
     def disarm(self) -> None:
         self._armed = False
         self._version = None
+        self._origin = "reload"
 
     def _baselines(self) -> dict:
         """Pre-swap baselines a ``baseline_factor`` rule resolves

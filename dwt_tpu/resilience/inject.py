@@ -57,6 +57,18 @@ production code at exactly the points the real fault would strike:
   ``take_sweep_job_fault(tag)`` yields a per-job ``DWT_FAULT_PLAN``
   (kill-mid-delta-promote) the supervisor injects into that pair's next
   spawn — a job dying inside a save, under the supervisor's watch.
+* serving-traffic kinds (``dwt_tpu/serve``): ``maybe_shift_request(i, x)``
+  applies ``serve_drift_shift`` — an affine input-distribution shift
+  (``x*scale + offset``) from request index ``at_request`` onward.
+  Deliberately NOT one-shot: a domain shift is a new steady state, not
+  an event — the online adapter must see it on every request until it
+  adapts.  ``maybe_poison_request(i, x)`` applies
+  ``serve_poison_requests`` — at each armed request index (one-shot per
+  index), the payload is replaced with garbage cycling NaN, Inf, and
+  out-of-band magnitudes by index: the sanitization layer must keep all
+  three out of the stat accumulator while serving stays healthy.  The
+  kinds compose (drift first — the world moved — then poison rides the
+  drifted stream).
 * :class:`FlakyDataset` — the in-process form: chosen indices raise for
   the first N accesses (transient I/O) or always (corrupt item), hang
   forever on their first access (``dead_worker_at`` — the pool worker
@@ -79,6 +91,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import signal
 import time
@@ -192,6 +205,17 @@ class FaultPlan:
     # mid-save; the supervisor must count the crash, respawn within the
     # budget, and the respawn resumes from the previous finalized step.
     sweep_job_kill_mid_save: Optional[List[str]] = None
+    # --- serving-traffic faults (dwt_tpu/serve) ------------------------
+    # 0-based request indices whose payload is replaced with garbage
+    # (cycling NaN / Inf / out-of-band magnitude by index) before
+    # submission.  One-shot per index.  The sanitization layer must keep
+    # every poisoned row out of the online-adaptation accumulator.
+    serve_poison_requests: Optional[List[int]] = None
+    # {"at_request": N, "offset": f, "scale": f} — from request index N
+    # onward, inputs become x*scale + offset: a synthetic target-domain
+    # shift.  Persistent (NOT one-shot): a domain shift is a new steady
+    # state the adapter must keep seeing until it adapts.
+    serve_drift_shift: Optional[Dict[str, Any]] = None
 
     _FIELDS = (
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
@@ -199,7 +223,8 @@ class FaultPlan:
         "notice_at_step", "kill_writer_mid_shard", "kill_mid_delta_promote",
         "missing_parent_blob", "dead_worker_at", "slow_item_at",
         "slow_item_s", "kill_supervisor_at_schedule", "sweep_preempt_pairs",
-        "sweep_job_kill_mid_save",
+        "sweep_job_kill_mid_save", "serve_poison_requests",
+        "serve_drift_shift",
     )
 
     @classmethod
@@ -366,6 +391,53 @@ class FaultPlan:
                 f"{ENV_VAR}: slow_item_s without slow_item_at arms "
                 "nothing — name the item the stall should hit"
             )
+        # Request indices are 0-based like item indices, not 1-based
+        # like steps.  Keep the normalized list: a scalar spec must arm.
+        poison = _as_step_list(
+            spec.get("serve_poison_requests"), "serve_poison_requests",
+            minimum=0,
+        )
+        drift = spec.get("serve_drift_shift")
+        if drift is not None:
+            if not isinstance(drift, dict):
+                raise ValueError(
+                    f"{ENV_VAR}: serve_drift_shift must be an object like "
+                    '{"at_request": N, "offset": f, "scale": f}; '
+                    f"got {drift!r}"
+                )
+            bad_keys = sorted(set(drift) - {"at_request", "offset", "scale"})
+            if bad_keys:
+                raise ValueError(
+                    f"{ENV_VAR}: unknown serve_drift_shift key(s) "
+                    f"{bad_keys}; valid: ['at_request', 'offset', 'scale']"
+                )
+            at = drift.get("at_request", 0)
+            if isinstance(at, bool) or not isinstance(at, int) or at < 0:
+                raise ValueError(
+                    f"{ENV_VAR}: serve_drift_shift.at_request must be a "
+                    f"0-based request index >= 0; got {at!r}"
+                )
+            offset = drift.get("offset", 0.0)
+            scale = drift.get("scale", 1.0)
+            for name, v in (("offset", offset), ("scale", scale)):
+                if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v):
+                    raise ValueError(
+                        f"{ENV_VAR}: serve_drift_shift.{name} must be a "
+                        f"finite number; got {v!r} — non-finite inputs are "
+                        "serve_poison_requests' job, not a domain shift"
+                    )
+            if float(scale) == 1.0 and float(offset) == 0.0:
+                raise ValueError(
+                    f"{ENV_VAR}: serve_drift_shift with scale=1 and "
+                    "offset=0 is the identity — a shift that moves "
+                    "nothing proves nothing"
+                )
+            drift = {
+                "at_request": at,
+                "offset": float(offset),
+                "scale": float(scale),
+            }
         return cls(
             nan_at_step=nan,
             crash_in_save=crash,
@@ -385,6 +457,8 @@ class FaultPlan:
             kill_supervisor_at_schedule=kill_supervisor,
             sweep_preempt_pairs=preempt_pairs,
             sweep_job_kill_mid_save=job_kill_mid_save,
+            serve_poison_requests=poison,
+            serve_drift_shift=drift,
         )
 
     @classmethod
@@ -622,6 +696,55 @@ def maybe_kill_supervisor_at_schedule(event: int) -> None:
     if int(plan.kill_supervisor_at_schedule) == int(event):
         plan.kill_supervisor_at_schedule = None  # one-shot (if we survive…)
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_shift_request(i: int, x: Any) -> Any:
+    """Apply the armed ``serve_drift_shift`` to request ``i``'s payload.
+
+    From ``at_request`` onward every input becomes ``x*scale + offset``
+    — a synthetic target-domain shift.  Deliberately NOT one-shot: a
+    domain shift is a new steady state, not an event, and the online
+    adapter must keep seeing the shifted distribution until it adapts.
+    Returns a shifted copy (never mutates the caller's array)."""
+    plan = current()
+    if plan is None or plan.serve_drift_shift is None:
+        return x
+    shift = plan.serve_drift_shift
+    if int(i) < int(shift.get("at_request", 0)):
+        return x
+    import numpy as np
+
+    x = np.asarray(x)
+    return (x * float(shift.get("scale", 1.0))
+            + float(shift.get("offset", 0.0))).astype(x.dtype)
+
+
+def maybe_poison_request(i: int, x: Any) -> Any:
+    """Replace request ``i``'s payload with garbage when armed.
+
+    One-shot per armed index.  The poison cycles by index — ``i % 3``
+    picks NaN, Inf, or an out-of-band magnitude (1e6) — so one composed
+    plan exercises every branch of the serve-side sanitizer.  Values are
+    written to a strided slice of a COPY: part of the row stays
+    plausible, the way a half-corrupted payload looks in production.
+    Compose with :func:`maybe_shift_request` drift-first (the world
+    moved; the poison rides the drifted stream)."""
+    plan = current()
+    if plan is None or not plan.serve_poison_requests:
+        return x
+    if int(i) not in plan.serve_poison_requests:
+        return x
+    plan.serve_poison_requests = [
+        r for r in plan.serve_poison_requests if r != int(i)
+    ] or None
+    import numpy as np
+
+    x = np.array(x, copy=True)
+    if not np.issubdtype(x.dtype, np.floating):
+        x = x.astype(np.float32)
+    val = (float("nan"), float("inf"), 1e6)[int(i) % 3]
+    x.reshape(-1)[::3] = val
+    return x
 
 
 def take_sweep_preempt(tag: str) -> bool:

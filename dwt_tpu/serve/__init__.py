@@ -11,6 +11,7 @@ HTTP front ends with graceful SIGTERM drain
 open-loop (Poisson arrivals) for latency-vs-offered-load curves.
 """
 
+from dwt_tpu.serve.adapt import DomainAdapter
 from dwt_tpu.serve.batcher import (
     DEFAULT_BUCKETS,
     Future,
@@ -25,6 +26,7 @@ from dwt_tpu.serve.metrics import AccessLog
 from dwt_tpu.serve.server import HttpServeClient, ServeClient
 
 __all__ = [
+    "DomainAdapter",
     "DEFAULT_BUCKETS",
     "Future",
     "MicroBatcher",

@@ -101,7 +101,14 @@ class _Request:
 
 @dataclass
 class PlannedBatch:
-    """One bucket-shaped dispatch: padded input + the requests riding it."""
+    """One bucket-shaped dispatch: padded input + the requests riding it.
+
+    Consumer contract: the padded tail rows of ``x`` (``mask`` False,
+    rows ``real_n:``) are REPEATED DATA, not samples.  Anything that
+    aggregates over the batch — returned logits, counts, and notably the
+    online-adaptation moment accumulator (``adapt.DomainAdapter.offer``
+    slices ``x[:real_n]``) — must honor the mask/``real_n`` split, or
+    whatever request landed last in a bucket gets double-weighted."""
 
     bucket: int
     x: np.ndarray          # [bucket, ...] padded

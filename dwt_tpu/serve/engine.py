@@ -255,6 +255,19 @@ class ServeEngine:
             return {"q": st.params, "scale": st.scales}
         return st.params
 
+    def _factorize_cache(self, batch_stats):
+        """Whiten cache from frozen stats: the shared compiled builder,
+        plus the one-time bf16 cast (serve_dtype) — factorization itself
+        is ALWAYS f32 (shared numerics with eval)."""
+        cache = self._cache_fn(batch_stats)
+        if cache and self._cache_dtype is not None:
+            cache = jax.tree.map(
+                lambda a: a.astype(self._cache_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                cache,
+            )
+        return cache
+
     def build_state(
         self, params, batch_stats, *, version: Optional[Version] = None
     ) -> EngineState:
@@ -267,16 +280,7 @@ class ServeEngine:
         buffer)."""
         with obs.span("build_state", "fleet",
                       version=version.label if version else "fresh"):
-            cache = self._cache_fn(batch_stats)
-            if cache and self._cache_dtype is not None:
-                # bf16 serving: the matrices FACTORIZED in f32 (the
-                # cache_fn's numerics are shared with eval and never
-                # change dtype), cast once here — frozen thereafter.
-                cache = jax.tree.map(
-                    lambda a: a.astype(self._cache_dtype)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                    cache,
-                )
+            cache = self._factorize_cache(batch_stats)
             scales = None
             if self.quantize:
                 # Off-dispatcher by the same contract as the cache
@@ -302,6 +306,37 @@ class ServeEngine:
                     scales = plan.place_replicated(scales)
         return EngineState(params, batch_stats, cache,
                            version or Version(), scales)
+
+    def build_state_from_stats(
+        self, base: EngineState, batch_stats, *, version: Version
+    ) -> EngineState:
+        """Adapted generation: ``base``'s params (and int8 scales)
+        UNCHANGED, a mutated ``batch_stats`` tree, and the whiten cache
+        refactorized from it — the serve-side online-adaptation build
+        path (``dwt_tpu.serve.adapt``).
+
+        Reusing ``base.params`` verbatim matters twice over: the params
+        are already device-placed (no re-upload per adapted generation),
+        and on a quantized engine they are already int8 — pushing them
+        back through :meth:`build_state` would re-quantize quantized
+        weights.  Off-dispatcher safe by the same contract as
+        :meth:`build_state`."""
+        with obs.span("build_state", "fleet", version=version.label,
+                      adapt=1):
+            cache = self._factorize_cache(batch_stats)
+            plan = self._plan
+            if plan.mode == "gspmd":
+                placed = plan.place(
+                    {"batch_stats": batch_stats, "whiten_cache": cache},
+                    "serve state",
+                )
+                batch_stats = placed["batch_stats"]
+                cache = placed["whiten_cache"] if cache else cache
+            else:
+                batch_stats = plan.place_replicated(batch_stats)
+                cache = plan.place_replicated(cache) if cache else cache
+        return EngineState(base.params, batch_stats, cache, version,
+                           base.scales)
 
     def build_state_from_tree(
         self, tree: dict, *, version: Optional[Version] = None,

@@ -30,8 +30,11 @@ mixed-version batch — asserted by tests), and per-version latency/error
 windows are what the canary's post-swap rollback reads.
 
 Fleet lifecycle events (``AccessLog.event``) ride the same JSONL stream
-with their own ``kind`` (``reload``/``canary``/``swap``/``rollback``) so
-one file tells the whole watch → canary → swap → rollback story.
+with their own ``kind`` (``reload``/``canary``/``swap``/``rollback`` for
+the checkpoint deploy path; ``adapt_build``/``adapt_canary``/
+``adapt_swap``/``adapt_rollback`` for online-adaptation generations) so
+one file tells the whole watch → canary → swap → rollback story —
+whichever producer drove the deploy.
 """
 
 from __future__ import annotations

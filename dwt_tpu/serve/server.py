@@ -78,6 +78,14 @@ class _Dispatcher(threading.Thread):
         self.access_log = access_log
         self.staging_depth = staging_depth
         self.error: Optional[BaseException] = None
+        # Optional per-batch observer ``fn(x, real_n)`` — the online
+        # adapter's harvest hook (``DomainAdapter.offer``).  None by
+        # default: the hot path pays one attribute read and nothing
+        # else, so a non-adaptive server stays bitwise-identical.
+        # Called AFTER the batch's futures resolve (never adds serving
+        # latency) with the padded batch tensor + its real-row count;
+        # the hook must be cheap and must not raise.
+        self.batch_hook = None
         # Liveness heartbeat: stamped at every batch-wait wake and every
         # resolved batch.  /healthz reports its age so an external prober
         # can tell a wedged dispatcher (age ≫ POLL_S with work queued)
@@ -217,6 +225,9 @@ class _Dispatcher(threading.Thread):
                         )
                         resolve_future(req.future, result=logits[lo:hi])
                 self._inflight.popleft()
+                hook = self.batch_hook
+                if hook is not None:
+                    hook(pb.x, pb.real_n)
                 self._beat = time.monotonic()
         except BaseException as e:
             # A staging/placement failure surfaces HERE (re-raised out of
@@ -291,6 +302,7 @@ class ServeClient:
         self._dispatcher = _Dispatcher(
             engine, self.batcher, self.access_log, staging_depth
         )
+        self.adapter = None  # attach_adapter (online domain adaptation)
         self._t0 = time.monotonic()
         # Live metrics: callback gauges sampled at scrape time — the
         # queue/in-flight/liveness quantities already have owners, so
@@ -323,6 +335,17 @@ class ServeClient:
             "dwt_serve_swap_count", "hot swaps since process start"
         )
         self._dispatcher.start()
+
+    def attach_adapter(self, adapter) -> None:
+        """Wire a :class:`~dwt_tpu.serve.adapt.DomainAdapter` into this
+        client: the dispatcher feeds it every dispatched bucket's real
+        rows, and ``/stats`` grows the adaptation fields.  The default
+        (no adapter) leaves the dispatch loop's behavior — and the
+        served bits — untouched."""
+        self.adapter = adapter
+        self._dispatcher.batch_hook = (
+            None if adapter is None else adapter.offer
+        )
 
     def refresh_version_metrics(self) -> None:
         """Re-stamp the served-version info gauge (scrape-time: a swap
@@ -371,6 +394,8 @@ class ServeClient:
                 "swap_count": getattr(self.engine, "swap_count", 0)}
                if version is not None else {}),
         )
+        if self.adapter is not None:
+            out["adaptation"] = self.adapter.stats()
         mem = _device_memory_stats()
         if mem is not None:
             out["device_memory"] = mem
@@ -868,6 +893,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "errors, error_rate, e2e_ms_p50, e2e_ms_p99); "
                         "baseline_factor thresholds resolve against the "
                         "pre-swap baseline armed at swap time")
+    # ---- online domain adaptation (dwt_tpu.serve.adapt) ----
+    p.add_argument("--adapt_every", type=float, default=0.0,
+                   help="online adaptation cadence (seconds): accumulate "
+                        "target-domain whitening/BN moments from live "
+                        "traffic (sanitized; padded rows excluded) and "
+                        "every N seconds fold them into a candidate "
+                        "generation that must pass the canary gate and "
+                        "the post-swap monitor exactly like a checkpoint "
+                        "reload.  0 (default) disables adaptation "
+                        "entirely — serving stays bitwise-identical to a "
+                        "non-adaptive server")
+    p.add_argument("--no-adapt", "--no_adapt", action="store_true",
+                   dest="no_adapt",
+                   help="kill switch: never adapt, whatever --adapt_every "
+                        "says (ops override for a replica misbehaving "
+                        "under adaptation)")
+    p.add_argument("--adapt_min_samples", type=int, default=64,
+                   help="minimum sanitized samples a window must hold "
+                        "before it may fold (a thin window folds nothing)")
+    p.add_argument("--adapt_momentum", type=float, default=0.25,
+                   help="EMA momentum folding the traffic window into the "
+                        "live stats (clamped by --adapt_max_momentum)")
+    p.add_argument("--adapt_max_momentum", type=float, default=0.5,
+                   help="hard clamp on the fold momentum: even a skewed "
+                        "window cannot move the stats further than this "
+                        "per generation")
+    p.add_argument("--adapt_batch", type=int, default=32,
+                   help="collect-forward batch size (one compiled shape; "
+                        "sanitized rows buffer until a full batch)")
+    p.add_argument("--adapt_max_abs", type=float, default=1e3,
+                   help="sanitization amplitude band: a row with any "
+                        "|value| beyond this never enters the accumulator "
+                        "(non-finite rows are always rejected)")
+    p.add_argument("--adapt_freeze_s", type=float, default=30.0,
+                   help="adaptation freeze after a rolled-back adapted "
+                        "generation; doubles per consecutive rollback and "
+                        "resets once an adapted generation survives its "
+                        "post-swap watch")
+    p.add_argument("--alert_rules", default=None,
+                   help="SLO alert rules JSON (obs/rules.py) evaluated "
+                        "against the live registry; while any rule fires "
+                        "— e.g. one on dwt_serve_domain_shift — "
+                        "adaptation freezes (fold into a healthy serving "
+                        "plane only)")
     p.add_argument("--data_parallel", action="store_true",
                    help="shard every bucket over all local devices (data "
                         "mesh replica fan-out)")
@@ -907,11 +976,12 @@ def load_canary_fixture(args, input_shape):
     return x, None
 
 
-def build_reloader(args, engine, access_log):
-    """--watch wiring: watcher + canary gate + post-swap monitor around
-    the live engine.  Imported lazily — ``dwt_tpu.fleet`` pulls in the
-    serve package and a module-level import would cycle."""
-    from dwt_tpu.fleet import CanaryGate, HotReloader, PostSwapMonitor
+def build_deploy_controller(args, engine, access_log):
+    """The shared canary-gate → swap → monitor pipeline both deploy
+    producers (``--watch`` hot reload, ``--adapt_every`` online
+    adaptation) submit through.  Imported lazily — ``dwt_tpu.fleet``
+    pulls in the serve package and a module-level import would cycle."""
+    from dwt_tpu.fleet import CanaryGate, DeployController, PostSwapMonitor
 
     rollback_rules = None
     if getattr(args, "rollback_rules", None):
@@ -919,10 +989,9 @@ def build_reloader(args, engine, access_log):
 
         rollback_rules = load_rules(args.rollback_rules)
     x, y = load_canary_fixture(args, engine.input_shape)
-    return HotReloader(
-        engine, args.ckpt_dir,
+    return DeployController(
+        engine,
         access_log=access_log,
-        poll_s=args.reload_poll_s,
         canary=CanaryGate(
             engine, x, y, max_regress_pp=args.canary_max_regress
         ),
@@ -934,6 +1003,54 @@ def build_reloader(args, engine, access_log):
             decide_after_s=args.rollback_decide_s,
             rules=rollback_rules,
         ),
+    )
+
+
+def build_reloader(args, engine, access_log, controller=None):
+    """--watch wiring: checkpoint watcher over the shared deploy
+    controller (pass ``controller=`` to share one with the adapter)."""
+    from dwt_tpu.fleet import HotReloader
+
+    if controller is None:
+        controller = build_deploy_controller(args, engine, access_log)
+    return HotReloader(
+        engine, args.ckpt_dir,
+        access_log=access_log,
+        poll_s=args.reload_poll_s,
+        controller=controller,
+    )
+
+
+def adapt_enabled(args) -> bool:
+    """Online adaptation runs only on an explicit cadence AND without
+    the kill switch — the default is a bitwise-inert serving path."""
+    return (getattr(args, "adapt_every", 0.0) or 0.0) > 0 \
+        and not getattr(args, "no_adapt", False)
+
+
+def build_adapter(args, engine, access_log, controller=None):
+    """--adapt_every wiring: the online stat accumulator over the shared
+    deploy controller, with the optional --alert_rules freeze feed."""
+    from dwt_tpu.serve.adapt import DomainAdapter
+
+    if controller is None:
+        controller = build_deploy_controller(args, engine, access_log)
+    alert_engine = None
+    if getattr(args, "alert_rules", None):
+        from dwt_tpu.obs.rules import AlertEngine, load_rules
+
+        alert_engine = AlertEngine(load_rules(args.alert_rules))
+    return DomainAdapter(
+        engine, controller,
+        access_log=access_log,
+        adapt_every_s=args.adapt_every,
+        min_samples=args.adapt_min_samples,
+        momentum=args.adapt_momentum,
+        max_momentum=args.adapt_max_momentum,
+        collect_batch=args.adapt_batch,
+        max_abs=args.adapt_max_abs,
+        freeze_base_s=args.adapt_freeze_s,
+        alert_engine=alert_engine,
     )
 
 
@@ -952,10 +1069,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         access_log=access_log,
         max_request_share=args.max_request_share,
     )
+    # One deploy pipeline for BOTH producers: when --watch and
+    # --adapt_every are both on, checkpoint reloads and adapted
+    # generations serialize through one controller, one canary baseline,
+    # one last-good rollback buffer.
+    controller = None
+    if args.watch or adapt_enabled(args):
+        controller = build_deploy_controller(args, engine, access_log)
     reloader = None
     if args.watch:
-        reloader = build_reloader(args, engine, access_log)
+        reloader = build_reloader(
+            args, engine, access_log, controller=controller
+        )
         reloader.start()
+    adapter = None
+    if adapt_enabled(args):
+        adapter = build_adapter(
+            args, engine, access_log, controller=controller
+        )
+        client.attach_adapter(adapter)
+        adapter.start()
 
     # Flag-only signal handling (the resilience PreemptionHandler
     # pattern): the handler must not touch locks/buffered I/O; the main
@@ -993,6 +1126,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "step": engine.step, "source": engine.source,
         "version": engine.version.label,
         "watch": bool(args.watch),
+        "adapt": adapter is not None,
         "compile_s": engine.compile_s,
     }), flush=True)
 
@@ -1003,6 +1137,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # be harmless (in-flight batches pin their snapshot) but would
         # muddy the final summary's version attribution.
         reloader.stop()
+    if adapter is not None:
+        adapter.stop()  # same contract: no adapted swap mid-drain
     # Half-close order: (1) stop admitting (new requests shed with
     # retry-after — the handler's `draining` check plus the batcher's
     # drain mode), (2) flush the queue through the engine, (3) stop the

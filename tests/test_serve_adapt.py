@@ -1,0 +1,716 @@
+"""Guarded online domain adaptation tests (ISSUE-18).
+
+Tier-1 (fast): sanitization units, the drift metric, exact-moment
+parity between ragged and padded dispatch (the batcher pad-and-mask
+seam), the min-sample gate and momentum clamp under a fake clock, the
+rollback → freeze → exponential re-arm ladder, the shifted-domain end
+to end (an adapted generation passes the canary and measurably closes
+the drift the frozen stats could not — cholesky AND swbn cache-refresh
+paths), the canary refusing a degraded adapted candidate, the post-swap
+rollback freezing the adapter, the ``--no-adapt``/default inertness
+contract, and the composed poison+drift chaos run (sanitized out, zero
+degraded swaps, healthy serving, intact access log).
+
+Slow-marked (tools/t1_budget.py discipline): the dwt-serve subprocess
+with live adaptation draining cleanly on SIGTERM.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    from dwt_tpu.resilience import inject
+
+    yield
+    inject.disarm()
+
+
+@pytest.fixture(scope="module")
+def adapt_setup():
+    """One LeNet state + engine shared by the adapter tests (compiles
+    are the cost; the engine's live state is restored after any test
+    that swaps)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import ServeEngine
+    from dwt_tpu.train import create_train_state
+
+    model = LeNetDWT(group_size=4)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    engine = ServeEngine(
+        model, state.params, state.batch_stats, (28, 28, 1),
+        buckets=(1, 4, 8), step=1, digest="seed",
+    )
+    return model, state, engine
+
+
+@pytest.fixture()
+def restored_engine(adapt_setup):
+    """Hand out the shared engine and put its original generation back
+    afterwards, whatever the test swapped in."""
+    model, state, engine = adapt_setup
+    original = engine.state
+    yield engine
+    engine.swap(original)
+
+
+def _make_adapter(engine, *, canary=None, monitor=None, access_log=None,
+                  clock=None, **kw):
+    from dwt_tpu.fleet import DeployController
+    from dwt_tpu.serve.adapt import DomainAdapter
+
+    controller = DeployController(
+        engine, access_log=access_log, canary=canary, monitor=monitor
+    )
+    kw.setdefault("adapt_every_s", 1.0)
+    kw.setdefault("min_samples", 16)
+    kw.setdefault("collect_batch", 8)
+    adapter = DomainAdapter(
+        engine, controller, access_log=access_log,
+        clock=clock or time.monotonic, **kw,
+    )
+    return adapter, controller
+
+
+# ----------------------------------------------------------- sanitization
+
+def test_sanitize_rows_rejects_nonfinite_and_out_of_band():
+    from dwt_tpu.serve.adapt import sanitize_rows
+
+    x = np.ones((5, 2, 2), np.float32)
+    x[1, 0, 0] = np.nan
+    x[2, 1, 1] = np.inf
+    x[3, 0, 1] = -np.inf
+    x[4] = 2e3  # finite but out of band
+    keep = sanitize_rows(x, max_abs=1e3)
+    assert keep.tolist() == [True, False, False, False, False]
+    # The band is inclusive, and an empty keep-set is representable.
+    assert sanitize_rows(np.full((1, 4), 1e3, np.float32), 1e3).all()
+    assert not sanitize_rows(np.full((2, 4), np.nan, np.float32), 1e3).any()
+
+
+def test_stats_drift_zero_on_identity_and_scale_free():
+    from dwt_tpu.serve.adapt import stats_drift
+
+    live = {"a": np.ones((3, 3)), "b": np.full((2,), 2.0)}
+    assert stats_drift(live, live) == 0.0
+    moved = {"a": live["a"] * 1.5, "b": live["b"] * 1.5}
+    d = stats_drift(live, moved)
+    assert d == pytest.approx(0.5, rel=1e-6)
+    # Scale-free: the same RELATIVE move measures the same on a model
+    # 10x the size.
+    big_live = {k: v * 10.0 for k, v in live.items()}
+    big_moved = {k: v * 15.0 for k, v in live.items()}
+    assert stats_drift(big_live, big_moved) == pytest.approx(d, rel=1e-6)
+
+
+# --------------------------------------- padded-dispatch moment parity
+
+def test_padded_rows_never_enter_moments_exact_parity(restored_engine):
+    """Satellite contract: the window stats advanced from a PADDED
+    dispatch (bucket tensor + real_n, the batcher's repeat-last-row
+    convention) are bitwise the stats advanced from the ragged real
+    rows.  Padding is plausible data — only the real_n slice may
+    count."""
+    import jax
+
+    engine = restored_engine
+    rng = np.random.default_rng(7)
+    real = rng.normal(size=(6, 28, 28, 1)).astype(np.float32)
+    padded = np.concatenate(
+        [real, np.repeat(real[-1:], 2, axis=0)], axis=0
+    )  # bucket 8, real_n 6 — the pad rows would pass sanitization
+
+    a_pad, _ = _make_adapter(engine, collect_batch=6)
+    a_rag, _ = _make_adapter(engine, collect_batch=6)
+    a_pad.offer(padded, real_n=6)
+    a_rag.offer(real, real_n=6)
+    a_pad._absorb(a_pad._drain_queue())
+    a_rag._absorb(a_rag._drain_queue())
+    assert a_pad.window_samples == a_rag.window_samples == 6
+    for lp, lr in zip(jax.tree.leaves(jax.device_get(a_pad._win_stats)),
+                      jax.tree.leaves(jax.device_get(a_rag._win_stats))):
+        np.testing.assert_array_equal(lp, lr)
+
+
+def test_dispatcher_hook_feeds_real_rows_only(restored_engine):
+    """ServeClient wiring: a ragged request dispatches as a padded
+    bucket, and the attached adapter's queue receives exactly the real
+    rows."""
+    from dwt_tpu.serve import ServeClient
+
+    engine = restored_engine
+    client = ServeClient(engine, max_batch_delay_ms=1.0)
+    adapter, _ = _make_adapter(engine)
+    client.attach_adapter(adapter)
+    try:
+        x = np.random.default_rng(3).normal(
+            size=(3, 28, 28, 1)
+        ).astype(np.float32)  # pads to bucket 4
+        client.infer(x)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            with adapter._qlock:
+                n = adapter._queue_samples
+            if n >= 3:
+                break
+            time.sleep(0.01)
+        batches = adapter._drain_queue()
+        assert sum(b.shape[0] for b in batches) == 3
+        np.testing.assert_array_equal(np.concatenate(batches, axis=0), x)
+        # Detach restores the bitwise-inert dispatch loop.
+        client.attach_adapter(None)
+        assert client._dispatcher.batch_hook is None
+    finally:
+        client.close()
+
+
+# --------------------------------------------------- gates and fold math
+
+def test_min_sample_gate_keeps_thin_window(restored_engine):
+    engine = restored_engine
+    clock = _FakeClock()
+    log_buf = io.StringIO()
+    from dwt_tpu.serve import AccessLog
+
+    alog = AccessLog(stream=log_buf)
+    adapter, _ = _make_adapter(
+        engine, access_log=alog, clock=clock,
+        min_samples=16, collect_batch=8,
+    )
+    x = np.random.default_rng(1).normal(
+        size=(8, 28, 28, 1)
+    ).astype(np.float32)
+    adapter.offer(x, real_n=8)
+    clock.t += 2.0  # past cadence
+    assert adapter.step() == "thin_window"
+    # The thin window is KEPT (it keeps accumulating), nothing deployed,
+    # and the drift gauge still updated (a quiet replica should alarm).
+    assert adapter.window_samples == 8
+    assert adapter.generation == 0
+    assert adapter.last_drift is not None
+    events = [json.loads(l) for l in log_buf.getvalue().splitlines()]
+    assert [e["kind"] for e in events] == ["adapt_build"]
+    assert events[0]["ok"] is False
+    assert events[0]["reason"] == "thin_window"
+    # More traffic crosses the gate on the next cadence.
+    adapter.offer(x, real_n=8)
+    clock.t += 2.0
+    assert adapter.step() in ("swapped", "refused")
+
+
+def test_momentum_clamp_bounds_the_fold(restored_engine):
+    """momentum=0.9 with max_momentum=0.5 folds at exactly 0.5: the
+    swapped generation's stats are live + 0.5*(window − live), leaf for
+    leaf (same float64-then-cast arithmetic)."""
+    import jax
+
+    engine = restored_engine
+    clock = _FakeClock()
+    adapter, controller = _make_adapter(
+        engine, clock=clock, min_samples=16, collect_batch=8,
+        momentum=0.9, max_momentum=0.5,
+    )
+    assert adapter._effective_momentum() == 0.5
+    rng = np.random.default_rng(2)
+    x = (rng.normal(size=(16, 28, 28, 1)) * 1.7 + 0.9).astype(np.float32)
+    adapter.offer(x, real_n=16)
+    adapter._absorb(adapter._drain_queue())
+    live_host = jax.device_get(engine.state.batch_stats)
+    win_host = jax.device_get(adapter._win_stats)
+    clock.t += 2.0
+    assert adapter.step() == "swapped"
+    expected = jax.tree.map(
+        lambda a, b: (
+            np.asarray(a) + 0.5 * (np.asarray(b, np.float64)
+                                   - np.asarray(a))
+        ).astype(np.asarray(a).dtype),
+        live_host, win_host,
+    )
+    got = jax.device_get(engine.state.batch_stats)
+    for e, g in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(e, g)
+    assert adapter.generation == 1 and controller.swap_count == 1
+    assert adapter.window_samples == 0  # the folded window is spent
+
+
+def test_rollback_freeze_doubles_and_rearms():
+    """The freeze ladder under a fake clock: base, 2x, 4x per
+    consecutive rollback, capped at max doublings; a surviving adapted
+    generation resets the counter; the window that built the bad
+    generation is dropped."""
+
+    class _StubEngine:
+        pass
+
+    class _StubController:
+        def add_verdict_listener(self, fn):
+            pass
+
+    from dwt_tpu.serve.adapt import DomainAdapter
+    from dwt_tpu.serve.engine import Version
+
+    clock = _FakeClock()
+    adapter = DomainAdapter.__new__(DomainAdapter)  # skip engine wiring
+    # Only the guard state matters for this unit.
+    adapter._clock = clock
+    adapter.freeze_base_s = 10.0
+    adapter.max_freeze_doublings = 2
+    adapter.alert_engine = None
+    adapter._frozen_until = 0.0
+    adapter._freeze_reason = None
+    adapter._consecutive_rollbacks = 0
+    adapter._win_stats = object()
+    adapter._win_samples = 5
+    adapter._pending_rows = [np.zeros((1, 2))]
+
+    class _Counter:
+        def labels(self, **kw):
+            return self
+
+        def inc(self, *a):
+            pass
+
+    adapter._m_generations = _Counter()
+    v = Version(1, "x")
+
+    adapter._on_verdict("reload", v, "rollback: not ours")
+    assert adapter.frozen_reason() is None  # checkpoint rollbacks ignored
+
+    adapter._on_verdict("adapt", v, "rollback: p99")
+    assert adapter._frozen_until == pytest.approx(10.0)
+    assert "rollback backoff" in adapter.frozen_reason()
+    assert adapter._win_stats is None and adapter._win_samples == 0
+    assert adapter._pending_rows == []
+
+    clock.t = 11.0
+    assert adapter.frozen_reason() is None  # re-armed on its own
+    adapter._on_verdict("adapt", v, "rollback: again")
+    assert adapter._frozen_until == pytest.approx(11.0 + 20.0)
+    clock.t = 40.0
+    adapter._on_verdict("adapt", v, "rollback: again")
+    assert adapter._frozen_until == pytest.approx(40.0 + 40.0)
+    clock.t = 90.0
+    adapter._on_verdict("adapt", v, "rollback: again")
+    assert adapter._frozen_until == pytest.approx(90.0 + 40.0)  # capped
+
+    adapter._on_verdict("adapt", v, "ok")
+    assert adapter._consecutive_rollbacks == 0
+
+
+def test_alert_firing_freezes_folding(restored_engine):
+    engine = restored_engine
+    clock = _FakeClock()
+
+    class _StubAlerts:
+        firing_now = ["serve_p99_slo"]
+
+        def maybe_evaluate(self):
+            pass
+
+        def firing(self):
+            return self.firing_now
+
+    alerts = _StubAlerts()
+    adapter, _ = _make_adapter(
+        engine, clock=clock, min_samples=8, collect_batch=8,
+        alert_engine=alerts,
+    )
+    x = np.random.default_rng(4).normal(
+        size=(8, 28, 28, 1)
+    ).astype(np.float32)
+    adapter.offer(x, real_n=8)
+    clock.t += 2.0
+    assert adapter.step() is None  # frozen: fold never attempted
+    assert "alert firing" in adapter.frozen_reason()
+    assert adapter.fold_attempts == 0 and adapter.generation == 0
+    # The alert clears; the next cadence folds.
+    alerts.firing_now = []
+    clock.t += 2.0
+    assert adapter.step() in ("swapped", "refused")
+
+
+# ------------------------------------------------- shifted-domain e2e
+
+@pytest.mark.parametrize("whitener", ["cholesky", "swbn"])
+def test_adapted_generation_beats_frozen_stats(whitener, tmp_path):
+    """Acceptance: under a shifted input domain, one canary-accepted
+    adapted generation measurably closes the gap the frozen stats
+    cannot — the drift of the NEXT traffic window against the adapted
+    stats is far below the drift against the frozen stats.  Covers both
+    the factorizing (cholesky) and the tracked-matrix (swbn) whiten
+    cache refresh paths, and the lifecycle events on the JSONL
+    stream."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dwt_tpu.fleet import CanaryGate
+    from dwt_tpu.nn import LeNetDWT
+    from dwt_tpu.serve import AccessLog, ServeEngine
+    from dwt_tpu.train import create_train_state
+
+    # momentum=0.6 (weight of the NEW observation) lets a 4-batch window
+    # track the traffic moments closely, so ONE fold shows up clearly in
+    # the drift metric; the production default (0.1) converges the same
+    # way, just over more cadences.
+    model = LeNetDWT(group_size=4, whitener=whitener, momentum=0.6)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)), jnp.float32)
+    state = create_train_state(
+        model, jax.random.key(0), sample, optax.identity()
+    )
+    engine = ServeEngine(
+        model, state.params, state.batch_stats, (28, 28, 1), buckets=(8,),
+        step=1, digest="seed",
+    )
+    canary_x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    log_buf = io.StringIO()
+    alog = AccessLog(stream=log_buf)
+    clock = _FakeClock()
+    from dwt_tpu.fleet import DeployController
+    from dwt_tpu.serve.adapt import DomainAdapter
+
+    controller = DeployController(
+        engine, access_log=alog, canary=CanaryGate(engine, canary_x)
+    )
+    adapter = DomainAdapter(
+        engine, controller, access_log=alog, adapt_every_s=1.0,
+        min_samples=32, collect_batch=8, momentum=0.5, clock=clock,
+    )
+
+    def shifted(n, seed):
+        r = np.random.default_rng(seed)
+        return (r.normal(size=(n, 28, 28, 1)) * 1.6 + 0.8).astype(
+            np.float32
+        )
+
+    v0 = engine.version.label
+    cache0 = engine.state.cache
+    adapter.offer(shifted(64, 1), real_n=64)
+    clock.t += 2.0
+    assert adapter.step() == "swapped"
+    drift_frozen = adapter.last_drift  # traffic vs the FROZEN stats
+    assert drift_frozen > 0
+    assert adapter.generation == 1
+    assert engine.version.label != v0
+    # The whiten cache was refactorized for the adapted stats: same
+    # structure, different leaves.
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(jax.device_get(cache0)),
+                        jax.tree.leaves(jax.device_get(engine.state.cache)))
+    )
+    assert changed
+    # Serving the shifted domain on the adapted generation stays finite.
+    assert np.isfinite(engine.infer(shifted(8, 9))).all()
+
+    # The SAME traffic distribution measured against the adapted stats:
+    # each fold closes the gap (deeper layers chase the earlier layers'
+    # new whitening, so convergence takes a few cadences — the drift
+    # must fall monotonically and substantially).
+    drifts = [drift_frozen]
+    for seed in (2, 3):
+        adapter.offer(shifted(64, seed), real_n=64)
+        clock.t += 2.0
+        assert adapter.step() in ("swapped", "refused")
+        drifts.append(adapter.last_drift)
+    assert drifts[1] < drifts[0] and drifts[2] < drifts[1]
+    drift_adapted = drifts[-1]
+    assert drift_adapted < 0.7 * drift_frozen
+
+    kinds = [json.loads(l)["kind"] for l in log_buf.getvalue().splitlines()]
+    assert kinds[:3] == ["adapt_build", "adapt_canary", "adapt_swap"]
+    swap_ev = [json.loads(l) for l in log_buf.getvalue().splitlines()
+               if json.loads(l)["kind"] == "adapt_swap"][0]
+    assert swap_ev["from_version"] == v0
+
+    # /stats adaptation fields ride the client surface.
+    from dwt_tpu.serve import ServeClient
+
+    client = ServeClient(engine, max_batch_delay_ms=1.0, access_log=alog)
+    client.attach_adapter(adapter)
+    try:
+        s = client.stats()["adaptation"]
+        assert s["generation"] == adapter.generation
+        assert s["frozen"] is False
+        assert s["domain_shift"] == pytest.approx(drift_adapted, abs=1e-6)
+    finally:
+        client.close()
+
+
+def test_canary_refuses_degraded_adapted_candidate(restored_engine):
+    """A window that would wreck fixture accuracy never goes live: the
+    gate's verdict is counted/logged as refused and the live generation
+    does not move."""
+    import jax
+
+    from dwt_tpu.fleet import CanaryGate
+
+    engine = restored_engine
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = np.argmax(engine.infer(x), axis=-1)  # live accuracy 100%
+    clock = _FakeClock()
+    adapter, controller = _make_adapter(
+        engine, canary=CanaryGate(engine, x, y, max_regress_pp=5.0),
+        clock=clock, min_samples=16, collect_batch=8, momentum=0.5,
+        max_momentum=1.0,
+    )
+    v0 = engine.version.label
+    # Degraded-but-finite window stats: every float moment leaf shoved
+    # far off the data manifold (integer leaves — the BN sample count —
+    # keep their dtype and value; the fold must preserve leaf dtypes for
+    # the compiled executables to accept the candidate at all).
+    live_host = jax.device_get(engine.state.batch_stats)
+    degraded = jax.tree.map(
+        lambda a: (
+            (np.asarray(a) + 1e4).astype(np.asarray(a).dtype)
+            if np.issubdtype(np.asarray(a).dtype, np.floating)
+            else np.asarray(a)
+        ),
+        live_host,
+    )
+    adapter._win_stats = degraded
+    adapter._win_samples = 64
+    verdict = adapter.try_fold()
+    assert verdict == "refused"
+    assert engine.version.label == v0
+    assert adapter.generation == 0 and controller.swap_count == 0
+    # A refusal is not a rollback: nothing freezes, the next window may
+    # try again immediately.
+    assert adapter.frozen_reason() is None
+
+
+def test_post_swap_rollback_freezes_then_rearms(restored_engine):
+    """The full consequence path: an adapted generation swaps in, the
+    post-swap monitor sees errors, the controller rolls back to the
+    pre-adaptation state, the adapter freezes, and the freeze expires on
+    its own."""
+    from dwt_tpu.fleet import PostSwapMonitor
+    from dwt_tpu.serve import AccessLog
+
+    engine = restored_engine
+    log_buf = io.StringIO()
+    alog = AccessLog(stream=log_buf)
+    clock = _FakeClock()
+    monitor = PostSwapMonitor(
+        alog, error_rate_threshold=0.2, min_requests=8,
+        decide_after_s=1000.0, clock=clock,
+    )
+    adapter, controller = _make_adapter(
+        engine, monitor=monitor, access_log=alog, clock=clock,
+        min_samples=16, collect_batch=8, freeze_base_s=10.0,
+    )
+    v0 = engine.version.label
+    x = np.random.default_rng(6).normal(
+        size=(16, 28, 28, 1)
+    ).astype(np.float32) * 1.5
+    adapter.offer(x, real_n=16)
+    clock.t += 2.0
+    assert adapter.step() == "swapped"
+    v1 = engine.version.label
+    assert v1 != v0 and monitor.armed and monitor.armed_origin == "adapt"
+
+    # The adapted generation serves nothing but errors.
+    for _ in range(8):
+        alog.record("error", 1, version=v1, error="boom")
+    t_rollback = clock.t
+    assert adapter.step() is None  # poll performed the rollback
+    assert engine.version.label == v0
+    assert controller.rollback_count == 1
+    assert adapter._consecutive_rollbacks == 1
+    reason = adapter.frozen_reason()
+    assert reason is not None and "rollback backoff" in reason
+    kinds = [json.loads(l)["kind"] for l in log_buf.getvalue().splitlines()
+             if json.loads(l)["kind"] != "access"]
+    assert "adapt_rollback" in kinds
+    # Frozen: the next cadence does not fold even with a fat window.
+    adapter.offer(x, real_n=16)
+    clock.t += 2.0
+    assert adapter.step() is None
+    assert adapter.generation == 1  # unchanged
+    # The freeze expires; adaptation re-arms by itself.
+    clock.t = t_rollback + 11.0 + 2.0
+    assert adapter.frozen_reason() is None
+
+
+# --------------------------------------------------------- inertness
+
+def test_no_adapt_default_is_inert(restored_engine):
+    """The kill switch and the default: adapt_enabled is False for the
+    stock parser, for --adapt_every 0, and for --no-adapt whatever the
+    cadence says; an unattached client's dispatch loop carries no hook
+    and /stats carries no adaptation block."""
+    from dwt_tpu.serve import ServeClient
+    from dwt_tpu.serve.server import adapt_enabled, build_parser
+
+    p = build_parser()
+    assert not adapt_enabled(p.parse_args([]))
+    assert adapt_enabled(p.parse_args(["--adapt_every", "5"]))
+    assert not adapt_enabled(
+        p.parse_args(["--adapt_every", "5", "--no-adapt"])
+    )
+    assert not adapt_enabled(
+        p.parse_args(["--adapt_every", "5", "--no_adapt"])
+    )
+
+    client = ServeClient(restored_engine, max_batch_delay_ms=1.0)
+    try:
+        assert client._dispatcher.batch_hook is None
+        assert "adaptation" not in client.stats()
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------------- chaos
+
+def test_chaos_poison_and_drift_composed(restored_engine):
+    """One composed DWT_FAULT_PLAN drives drifted traffic with poisoned
+    requests riding it through the real client + adapter: every
+    poisoned row is sanitized out of the accumulator, no adapted
+    generation is rolled back (zero degraded swaps), serving stays
+    healthy, and the access log is intact JSONL."""
+    from dwt_tpu.fleet import CanaryGate
+    from dwt_tpu.resilience import inject
+    from dwt_tpu.serve import AccessLog, ServeClient
+
+    engine = restored_engine
+    inject.arm(inject.FaultPlan.from_spec({
+        "serve_poison_requests": [3, 6, 9, 12],
+        "serve_drift_shift": {"at_request": 0, "offset": 0.7,
+                              "scale": 1.4},
+    }))
+    log_buf = io.StringIO()
+    alog = AccessLog(stream=log_buf)
+    clock = _FakeClock()
+    canary_x = np.random.default_rng(8).normal(
+        size=(8, 28, 28, 1)
+    ).astype(np.float32)
+    adapter, controller = _make_adapter(
+        engine, canary=CanaryGate(engine, canary_x), access_log=alog,
+        clock=clock, min_samples=16, collect_batch=8,
+    )
+    client = ServeClient(engine, max_batch_delay_ms=1.0, access_log=alog)
+    client.attach_adapter(adapter)
+    try:
+        base = np.random.default_rng(9).normal(
+            size=(1, 28, 28, 1)
+        ).astype(np.float32)
+        served = 0
+        for i in range(24):
+            xi = inject.maybe_shift_request(i, base)
+            xi = inject.maybe_poison_request(i, xi)
+            out = client.infer(xi)
+            assert out.shape[0] == 1
+            served += 1
+            if i % 8 == 7:  # fold mid-traffic, like the cadence thread
+                clock.t += 2.0
+                adapter.step()
+        clock.t += 2.0
+        adapter.step()
+    finally:
+        client.close()
+    # Every poisoned request was served (a bad payload 500s itself at
+    # worst — here it serves; it NEVER reaches the stats)...
+    assert served == 24
+    # ...and every poisoned row was dropped at the sanitizer.
+    assert adapter.dropped_rows == 4
+    # Zero degraded swaps: whatever adapted, nothing rolled back.
+    assert controller.rollback_count == 0
+    assert adapter._consecutive_rollbacks == 0
+    # The drifted-but-clean traffic did adapt.
+    assert adapter.fold_attempts >= 1
+    # The access log is intact JSONL, access + adapt lifecycle only.
+    kinds = set()
+    for line in log_buf.getvalue().splitlines():
+        kinds.add(json.loads(line)["kind"])
+    assert "access" in kinds
+    assert not any(k.endswith("rollback") for k in kinds)
+
+
+@pytest.mark.slow
+def test_sigterm_drain_with_live_adaptation(tmp_path):
+    """dwt-serve with --adapt_every under traffic: serve_ready reports
+    the adapter, /stats grows the adaptation block, and SIGTERM drains
+    to exit 0 with an intact access log — the adapter thread never
+    wedges the drain."""
+    access = str(tmp_path / "access.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.serve.server",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2", "--port", "0",
+         "--access_log", access,
+         "--adapt_every", "0.3", "--adapt_min_samples", "4",
+         "--adapt_batch", "4"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "serve_ready"
+        assert ready["adapt"] is True
+        port = ready["port"]
+        rng = np.random.default_rng(0)
+
+        import urllib.request
+
+        def _post(x):
+            body = json.dumps({"inputs": np.asarray(x).tolist()}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/infer", data=body, method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return resp.status, json.loads(resp.read())
+
+        x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+        for _ in range(8):
+            status, payload = _post(x)
+            assert status == 200 and len(payload["logits"]) == 4
+        time.sleep(0.7)  # at least one adaptation cadence under traffic
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30.0
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert "adaptation" in stats
+        assert stats["adaptation"]["generation"] >= 0
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        out = proc.stdout.read()
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["kind"] == "serve_summary"
+        for line in open(access).read().splitlines():
+            json.loads(line)  # intact JSONL, no torn records
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -198,6 +198,11 @@ def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
                 "queue_ms_p50", "queue_ms_p99",
                 "device_ms_p50", "device_ms_p99",
                 "swap_e2e_ms_p99", "steady_e2e_ms_p99",
+                # online-adaptation arm (--adapt_every): swap-window vs
+                # steady tail under live adaptation cadence, plus the
+                # canary-accepted generation count for the load.
+                "adapt_swap_e2e_ms_p99", "adapt_steady_e2e_ms_p99",
+                "adapt_generations",
                 # reduced-precision serve arms (present when the run was
                 # taken with --serve_dtype bf16 / --quantize_int8): the
                 # same record keys, re-published under a precision tag so

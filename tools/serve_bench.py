@@ -38,6 +38,18 @@ checkpoint every N seconds DURING each load — the continuous-deployment
 fleet's restore → build → canary → atomic-swap path under traffic —
 and splits the served tail into swap-window vs steady-state percentiles
 (PERF.md "Fleet").
+
+``--adapt_every N`` (inherited server flag) runs the online
+domain-adaptation loop DURING each load: the dispatcher feeds live
+batches to the stat accumulator, and every N seconds an adapted
+generation goes through the same canary → swap pipeline.  The record
+splits the tail the same way (``adapt_swap_e2e_ms_p99`` vs
+``adapt_steady_e2e_ms_p99``, same ``--swap_window_s``) and adds
+``adapt_generations`` (canary-accepted folds this load) — the
+adaptation-cadence-cost probe (PERF.md "Online adaptation").  A
+``DWT_FAULT_PLAN`` with ``serve_drift_shift`` / ``serve_poison_requests``
+perturbs the generated traffic per request index, so one bench run can
+drive the adapt-under-shift (or under-poison) scenario end to end.
 """
 
 from __future__ import annotations
@@ -74,7 +86,7 @@ def _build_client(args):
 def run_load(client, input_shape, offered: float, seconds: float,
              request_n: int, seed: int = 0,
              reloader=None, reload_every_s: float = 0.0,
-             swap_window_s: float = 0.5) -> dict:
+             swap_window_s: float = 0.5, adapter=None) -> dict:
     """One open-loop measurement at ``offered`` imgs/s for ``seconds``.
 
     Arrivals are Poisson (exponential gaps) in REQUEST units
@@ -89,7 +101,15 @@ def run_load(client, input_shape, offered: float, seconds: float,
     latency tail into ``swap_*`` (requests resolved within
     ``swap_window_s`` after a swap, sliced on the access log's
     resolution stamps) vs ``steady_*`` — the swap-cost-under-load probe.
+
+    ``adapter``: a started :class:`~dwt_tpu.serve.adapt.DomainAdapter`
+    already attached to ``client``.  Its swaps are detected by polling
+    the accepted-generation counter (the adapter runs on its own
+    cadence thread; the bench only observes), timestamped on the same
+    resolution-stamp timebase, and split into ``adapt_swap_*`` vs
+    ``adapt_steady_*`` with the same window.
     """
+    from dwt_tpu.resilience import inject
     from dwt_tpu.serve.batcher import ShedError
 
     rng = np.random.default_rng(seed)
@@ -125,15 +145,37 @@ def run_load(client, input_shape, offered: float, seconds: float,
             except Exception as e:  # keep the bench honest, not dead
                 print(f"serve_bench: swap failed: {e}", file=sys.stderr)
 
+    adapt_ts = []  # adapted-swap stamps, same timebase as swap_ts
+    gen0 = adapter.generation if adapter is not None else 0
+
+    def _adapt_watch():
+        # Observe, don't drive: the adapter folds on its own thread; a
+        # 50 ms poll of the accepted-generation counter timestamps each
+        # swap well inside the 0.5 s attribution window.
+        seen = gen0
+        while not done.wait(0.05):
+            gen = adapter.generation
+            if gen > seen:
+                adapt_ts.extend(
+                    [time.perf_counter() - client.access_log.t0]
+                    * (gen - seen)
+                )
+                seen = gen
+
     def _submit_all():
         nonlocal shed
         t0 = time.perf_counter()
-        for t_arr in arrivals:
+        for i, t_arr in enumerate(arrivals):
             delay = t0 + t_arr - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
+            # Armed DWT_FAULT_PLAN serving kinds perturb the open-loop
+            # traffic itself (no-ops when disarmed): drift first — the
+            # world moved — then poison rides the drifted stream.
+            xi = inject.maybe_shift_request(i, x)
+            xi = inject.maybe_poison_request(i, xi)
             try:
-                futures.append(client.submit(x))
+                futures.append(client.submit(xi))
             except ShedError:
                 shed += 1
 
@@ -141,6 +183,10 @@ def run_load(client, input_shape, offered: float, seconds: float,
     swapper = None
     if reloader is not None and reload_every_s > 0:
         swapper = threading.Thread(target=_swap_loop, daemon=True)
+    watcher = None
+    if adapter is not None:
+        watcher = threading.Thread(target=_adapt_watch, daemon=True)
+        watcher.start()
     t_start = time.perf_counter()
     submitter.start()
     if swapper is not None:
@@ -160,6 +206,8 @@ def run_load(client, input_shape, offered: float, seconds: float,
     done.set()
     if swapper is not None:
         swapper.join(timeout=60.0)
+    if watcher is not None:
+        watcher.join(timeout=60.0)
     after = client.access_log.windows()
     delta = after["served_requests"] - before["served_requests"]
 
@@ -205,6 +253,28 @@ def run_load(client, input_shape, offered: float, seconds: float,
                                  prefix="swap_e2e_ms_p"),
             **percentile_summary(steady, (50.0, 99.0),
                                  prefix="steady_e2e_ms_p"),
+        )
+    if adapter is not None:
+        e2e = after["e2e_ms"][-delta:] if delta > 0 else []
+        tstamps = after["resolved_t"][-delta:] if delta > 0 else []
+        in_adapt = [
+            v for v, t in zip(e2e, tstamps)
+            if any(ts <= t <= ts + swap_window_s for ts in adapt_ts)
+        ]
+        adapt_steady = [
+            v for v, t in zip(e2e, tstamps)
+            if not any(ts <= t <= ts + swap_window_s for ts in adapt_ts)
+        ]
+        record.update(
+            adapt_generations=adapter.generation - gen0,
+            adapt_swaps=len(adapt_ts),
+            adapt_swap_window_s=swap_window_s,
+            adapt_swap_requests=len(in_adapt),
+            adapt_fold_attempts=adapter.fold_attempts,
+            **percentile_summary(in_adapt, (50.0, 99.0),
+                                 prefix="adapt_swap_e2e_ms_p"),
+            **percentile_summary(adapt_steady, (50.0, 99.0),
+                                 prefix="adapt_steady_e2e_ms_p"),
         )
     return record
 
@@ -256,6 +326,25 @@ def main(argv=None) -> int:
             access_log=client.access_log,
             canary=CanaryGate(client.engine, canary_x),
         )
+    adapter = None
+    from dwt_tpu.serve.server import adapt_enabled
+
+    if adapt_enabled(args):
+        # The real serve-side adaptation loop: dispatcher hook → stat
+        # accumulator → canary → swap, on its own cadence thread.  The
+        # bench measures what serving pays for it, per load point.
+        from dwt_tpu.serve.server import (
+            build_adapter, build_deploy_controller,
+        )
+
+        controller = build_deploy_controller(
+            args, client.engine, client.access_log
+        )
+        adapter = build_adapter(
+            args, client.engine, client.access_log, controller=controller
+        )
+        client.attach_adapter(adapter)
+        adapter.start()
     rng = np.random.default_rng(args.seed)
     warm = rng.normal(
         size=(args.request_n,) + tuple(input_shape)
@@ -280,7 +369,7 @@ def main(argv=None) -> int:
                 client, input_shape, offered, args.duration_s,
                 args.request_n, seed=args.seed,
                 reloader=reloader, reload_every_s=args.reload_every,
-                swap_window_s=args.swap_window_s,
+                swap_window_s=args.swap_window_s, adapter=adapter,
             )
             if tags:
                 record["precision"] = "+".join(tags)
@@ -293,6 +382,8 @@ def main(argv=None) -> int:
                         record[f"{tag}_e2e_ms_p99"] = record["e2e_ms_p99"]
             print(json.dumps(record), flush=True)
     finally:
+        if adapter is not None:
+            adapter.stop()  # no adapted swap mid-drain
         client.close(drain=True)
         obs.export()  # no-op unless --obs_trace/DWT_OBS_TRACE
     return rc
