@@ -52,6 +52,18 @@ def build_parser() -> argparse.ArgumentParser:
     # dwt_tpu extensions
     p.add_argument("--arch", choices=["resnet50", "resnet101", "tiny"],
                    default=d.arch)
+    p.add_argument("--backbone", type=str, default=d.backbone,
+                   help="backbone-registry entry (wins over --arch): "
+                        "resnet50|resnet101|resnet152|tiny|vit_dwt|"
+                        "vit_tiny — resnet152/vit_dwt are the "
+                        ">1-chip-HBM entries the fsdp preset targets "
+                        "(dwt_tpu.nn.registry)")
+    p.add_argument("--pad_classes_to", type=int, default=d.pad_classes_to,
+                   help=">1: pad the classifier head's out dim up to a "
+                        "multiple of this so an fsdp/model rules table "
+                        "can shard the head when num_classes is "
+                        "indivisible; padded logit columns are sliced "
+                        "off inside the forward — counters stay exact")
     p.add_argument("--stat_collection_passes", type=int,
                    default=d.stat_collection_passes)
     p.add_argument("--synthetic", action="store_true")
@@ -95,7 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "'dp' (replicate all state — bitwise the legacy "
                         "paths), preset 'model' (out-channel model "
                         "sharding, whitening/BN stats pinned replicated), "
-                        "or a path to a JSON [[regex, spec], ...] file")
+                        "preset 'fsdp' (shard ALL conv/dense kernels + "
+                        "their Adam moments over the model axis — "
+                        "per-host param+opt-state at ~1/model_axis; "
+                        "stats stay replicated), or a path to a JSON "
+                        "[[regex, spec], ...] file")
     p.add_argument("--steps_per_dispatch", type=int,
                    default=d.steps_per_dispatch,
                    help=">1: run k train steps per dispatch (lax.scan "

@@ -206,6 +206,16 @@ class OfficeHomeConfig:
     stat_collection_passes: int = 10  # eval_pass_collect_stats (:384)
     # dwt_tpu extensions
     arch: str = "resnet50"  # or "resnet101" (VisDA config)
+    # Backbone-registry override (dwt_tpu.nn.registry.BACKBONES): when
+    # set, wins over --arch.  resnet152 / vit_dwt are the >1-chip-HBM
+    # entries the fsdp sharding preset exists for.
+    backbone: Optional[str] = None
+    # >1: pad the fc_out head's out dim up to a multiple of this so a
+    # model-sharding rules table (fsdp preset) can shard the classifier
+    # head even when num_classes is indivisible; padded logit columns
+    # are sliced off inside the forward, so loss/accuracy/serve counters
+    # stay exact (see nn/resnet.py pad_classes_to).
+    pad_classes_to: int = 0
     synthetic: bool = False
     synthetic_size: int = 64
     data_parallel: bool = False

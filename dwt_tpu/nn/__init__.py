@@ -29,7 +29,9 @@ from dwt_tpu.nn.norms import (
     split_domains,
 )
 from dwt_tpu.nn.lenet import LeNetDWT
-from dwt_tpu.nn.resnet import BottleneckDWT, ResNetDWT
+from dwt_tpu.nn.resnet import BottleneckDWT, ResNetDWT, padded_num_classes
+from dwt_tpu.nn.vit import TransformerBlockDWT, ViTDWT
+from dwt_tpu.nn.registry import BACKBONES, build_backbone, register_backbone
 
 __all__ = [
     "DomainBatchNorm",
@@ -40,4 +42,10 @@ __all__ = [
     "LeNetDWT",
     "BottleneckDWT",
     "ResNetDWT",
+    "TransformerBlockDWT",
+    "ViTDWT",
+    "padded_num_classes",
+    "BACKBONES",
+    "build_backbone",
+    "register_backbone",
 ]

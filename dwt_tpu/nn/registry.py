@@ -1,0 +1,54 @@
+"""Backbone registry — one name, one constructor, every subsystem agnostic.
+
+The train loop, EvalPipeline, ServeEngine, and checkpoint machinery all
+consume a backbone through the same attribute surface (``num_classes``,
+``num_domains``, ``eval_domain``, ``whitener``, ...) and input contract
+(train ``[D, N, H, W, C]`` / eval ``[N, H, W, C]``).  This registry is
+the ONLY place a backbone name is interpreted: ``--backbone resnet152``
+or ``--backbone vit_dwt`` flows through ``build_backbone`` and nothing
+downstream special-cases the architecture.  Rules tables (the ``fsdp``
+preset, ``configs/*.json``) are the other half of the contract — they
+match on layer *names*, so new backbones keep the ``conv*``/dense
+``kernel`` naming convention (see ``parallel/plan.py``).
+
+``register_backbone`` lets experiment forks add entries without editing
+this file (e.g. a conftest registering a test-only stub).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from dwt_tpu.nn.resnet import ResNetDWT
+from dwt_tpu.nn.vit import ViTDWT
+
+# name -> ctor(**model_kwargs) -> flax Module.  All ctors accept the
+# common kwarg surface (num_classes, group_size, num_domains, momentum,
+# axis_name, dtype, remat, use_pallas, whitener, pad_classes_to, ...).
+BACKBONES: Dict[str, Callable[..., object]] = {
+    "resnet50": ResNetDWT.resnet50,
+    "resnet101": ResNetDWT.resnet101,
+    "resnet152": ResNetDWT.resnet152,
+    # The CI/dryrun miniature (stage_sizes (1,1,1,1)) — kept under its
+    # historical --arch name.
+    "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
+    "vit_dwt": ViTDWT.vit_dwt,
+    "vit_tiny": ViTDWT.vit_tiny,
+}
+
+
+def build_backbone(name: str, **kwargs):
+    """Construct the named backbone, or raise listing what's registered."""
+    try:
+        ctor = BACKBONES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backbone {name!r}; registered: "
+            f"{', '.join(sorted(BACKBONES))}"
+        ) from None
+    return ctor(**kwargs)
+
+
+def register_backbone(name: str, ctor: Callable[..., object]) -> None:
+    """Add/override a registry entry (test stubs, experiment forks)."""
+    BACKBONES[name] = ctor

@@ -142,6 +142,15 @@ class ResNetDWT(fnn.Module):
     remat: bool = False
     use_pallas: bool = False  # Pallas whitening kernels (single-chip)
     whitener: str = "cholesky"  # whitening numerics backend (--whitener)
+    # >1: pad the fc_out head's out dim up to a multiple of this value so
+    # a model-sharding rules table (the fsdp preset) can place the head
+    # on the model axis even when num_classes (65, ...) is indivisible.
+    # The padded logit columns are sliced off INSIDE the forward — loss,
+    # accuracy counters, and serve only ever see [N, num_classes], and a
+    # Dense output column depends only on its own kernel column, so the
+    # real logits are bitwise those of an unpadded head with the same
+    # weights.  0/1 = no padding (byte-for-byte today's head).
+    pad_classes_to: int = 0
 
     @classmethod
     def resnet50(cls, **kw) -> "ResNetDWT":
@@ -152,6 +161,13 @@ class ResNetDWT(fnn.Module):
     def resnet101(cls, **kw) -> "ResNetDWT":
         """[3,4,23,3] — the VisDA-2017 variant (BASELINE.json configs[4])."""
         return cls(stage_sizes=(3, 4, 23, 3), **kw)
+
+    @classmethod
+    def resnet152(cls, **kw) -> "ResNetDWT":
+        """[3,8,36,3] — the >1-chip-HBM backbone the fsdp preset exists
+        for (params + Adam moments ~0.7 GB f32 replicated; the rules
+        table holds per-host state at ~1/model_axis of that)."""
+        return cls(stage_sizes=(3, 8, 36, 3), **kw)
 
     @fnn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -225,8 +241,21 @@ class ResNetDWT(fnn.Module):
                 )(x, train)
 
         x = jnp.mean(x, axis=(-3, -2))  # global average pool → [B, C]
-        x = fnn.Dense(self.num_classes, dtype=self.dtype, name="fc_out")(x)
+        x = fnn.Dense(
+            padded_num_classes(self.num_classes, self.pad_classes_to),
+            dtype=self.dtype,
+            name="fc_out",
+        )(x)
+        x = x[..., : self.num_classes]  # no-op unless the head is padded
 
         if train:
             x = split_domains(x, self.num_domains)
         return x
+
+
+def padded_num_classes(num_classes: int, pad_to: int) -> int:
+    """Head out-dim under pad-to-divisible: ``num_classes`` rounded up to
+    a multiple of ``pad_to`` (0/1 = unpadded)."""
+    if pad_to and pad_to > 1:
+        return -(-num_classes // pad_to) * pad_to
+    return num_classes

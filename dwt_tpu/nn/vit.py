@@ -1,0 +1,247 @@
+"""ViT-DWT — the paper's whitening op at transformer module boundaries.
+
+A genuinely new placement for Domain Whitening (the paper only studies
+conv nets): per-domain grouped whitening applied to the token stream at
+the **patch-embed boundary** and at **transformer-block boundaries**, in
+the spirit of Decorrelated Batch Normalization's whiten-at-module-
+boundary design (arXiv:1804.08450).  The whitening op itself is reused
+unchanged — ``group_whiten`` reduces moments over ALL leading axes, so
+``[B, L, C]`` token batches feed the same ``[.., C]`` sites the conv
+nets use, and the triple stat-branch / shared-affine contract (source /
+target / augmented-target sharing one ``gamma``/``beta``) carries over
+verbatim.
+
+Depth placement mirrors the ResNet recipe (stem + stage 1 whiten, deeper
+stages batch-normalize): the patch embed and the first quarter of blocks
+carry ``DomainWhiten`` sites, the rest ``DomainBatchNorm`` — whitening
+where domain covariance structure is strongest (low-level statistics),
+cheap BN where features are already task-aligned.
+
+Sharding-first construction: every weight matrix — attention q/k/v/out,
+MLP fc1/fc2, the head — is a plain 2-D ``fnn.Dense`` kernel (never
+DenseGeneral's 3-D form), and the patch embed is named ``conv_patch`` so
+the fsdp preset's 4-D conv rule claims its kernel.  Under
+``--sharding_rules fsdp`` the whole backbone model-shards out of the box
+(stats/whiten_cache pinned replicated), and ``pad_classes_to`` makes the
+head divisible — see ``parallel/plan.py``.
+
+Train input ``[D, N, H, W, C]`` / eval ``[N, H, W, C]``, the same
+contract as :class:`~dwt_tpu.nn.resnet.ResNetDWT`, so the train loop,
+EvalPipeline, ServeEngine, and checkpoints flow unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as fnn
+
+from dwt_tpu.nn.norms import (
+    AxisName,
+    DomainBatchNorm,
+    DomainWhiten,
+    apply_domain_norm,
+    merge_domains,
+    split_domains,
+)
+from dwt_tpu.nn.resnet import _conv_init, padded_num_classes
+
+
+class TransformerBlockDWT(fnn.Module):
+    """Pre-LN transformer block with a domain-norm site at its boundary.
+
+    LayerNorm inside the residual branches is per-token (domain-blind,
+    like the convs); the DWT structure lives in the boundary site, where
+    the block's output tokens are whitened/normalized per domain branch.
+    """
+
+    width: int
+    num_heads: int
+    mlp_ratio: int = 4
+    use_whitening: bool = False
+    group_size: int = 4
+    num_domains: int = 3
+    eval_domain: int = 1
+    momentum: float = 0.1
+    axis_name: Optional[AxisName] = None
+    dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
+    whitener: str = "cholesky"
+
+    def _make_norm(self, features: int, name: str):
+        kw = dict(
+            num_domains=self.num_domains,
+            eval_domain=self.eval_domain,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+            name=name,
+        )
+        if self.use_whitening:
+            return DomainWhiten(
+                features, self.group_size, use_pallas=self.use_pallas,
+                whitener=self.whitener, **kw
+            )
+        return DomainBatchNorm(features, **kw)
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        dense = partial(fnn.Dense, dtype=self.dtype)
+        ch = self.width
+        head_dim = ch // self.num_heads
+
+        h = fnn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        # Plain 2-D Dense kernels (NOT DenseGeneral's [C, heads, hd]):
+        # the fsdp preset's dense rule shards out-features over the model
+        # axis, which is only correct on 2-D kernels.
+        q = dense(ch, name="attn_q")(h)
+        k = dense(ch, name="attn_k")(h)
+        v = dense(ch, name="attn_v")(h)
+
+        def heads(t: jax.Array) -> jax.Array:
+            t = t.reshape(t.shape[:-1] + (self.num_heads, head_dim))
+            return t.transpose(0, 2, 1, 3)  # [B, H, L, hd]
+
+        q, k, v = heads(q), heads(k), heads(v)
+        attn = jax.nn.softmax(
+            (q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5), axis=-1
+        )
+        o = (attn @ v).transpose(0, 2, 1, 3).reshape(x.shape[:-1] + (ch,))
+        x = x + dense(ch, name="attn_out")(o)
+
+        h = fnn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = fnn.gelu(dense(ch * self.mlp_ratio, name="mlp_fc1")(h))
+        x = x + dense(ch, name="mlp_fc2")(h)
+
+        # Block-boundary domain site: [D*N, L, C] splits to [D, N, L, C],
+        # group_whiten/batch_norm reduce over (N, L) per branch.
+        return apply_domain_norm(
+            x, self._make_norm(ch, "dn"), train, self.num_domains
+        )
+
+
+class ViTDWT(fnn.Module):
+    """ViT backbone with domain whitening at module boundaries.
+
+    Same attribute surface and input contract as ``ResNetDWT`` so every
+    subsystem (train loop, EvalPipeline, ServeEngine, checkpoints,
+    sharding plans) consumes it with no special-casing.
+    """
+
+    patch_size: int = 16
+    depth: int = 12
+    width: int = 384
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    num_classes: int = 65
+    group_size: int = 4
+    num_domains: int = 3
+    eval_domain: int = 1
+    momentum: float = 0.1
+    axis_name: Optional[AxisName] = None
+    dtype: jnp.dtype = jnp.float32
+    whiten: bool = True  # False: every site is DomainBatchNorm (ablation)
+    remat: bool = False  # jax.checkpoint per block (HBM for FLOPs)
+    use_pallas: bool = False
+    whitener: str = "cholesky"
+    pad_classes_to: int = 0  # see ResNetDWT.pad_classes_to
+
+    @classmethod
+    def vit_dwt(cls, **kw) -> "ViTDWT":
+        """ViT-S/16-shaped flagship (384 wide, 12 deep, 6 heads)."""
+        return cls(**kw)
+
+    @classmethod
+    def vit_tiny(cls, **kw) -> "ViTDWT":
+        """Small-config twin for tests/CI dryruns (32 wide, 2 deep)."""
+        return cls(patch_size=4, depth=2, width=32, num_heads=4, **kw)
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        if train:
+            if x.shape[0] != self.num_domains:
+                raise ValueError(
+                    f"train input must be [domains={self.num_domains}, "
+                    f"N, H, W, C]; got {x.shape}"
+                )
+            x = merge_domains(x)
+        if x.shape[-3] % self.patch_size or x.shape[-2] % self.patch_size:
+            raise ValueError(
+                f"input spatial dims {x.shape[-3:-1]} must be divisible "
+                f"by patch_size={self.patch_size}"
+            )
+        x = x.astype(self.dtype)
+
+        # Patch embed: named conv_patch so the fsdp preset's 4-D conv
+        # rule claims its [p, p, 3, width] kernel (out-channel sharding).
+        p = self.patch_size
+        x = fnn.Conv(
+            self.width, (p, p), strides=(p, p), use_bias=False,
+            dtype=self.dtype, kernel_init=_conv_init, name="conv_patch",
+        )(x)
+        x = x.reshape(x.shape[0], -1, self.width)  # [B, L, C]
+        pos = self.param(
+            "pos_embed", fnn.initializers.normal(0.02),
+            (1, x.shape[1], self.width), jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+
+        # Patch-embed boundary whitening site (the "stem" site).
+        stem_kw = dict(
+            num_domains=self.num_domains,
+            eval_domain=self.eval_domain,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+            name="dn_patch",
+        )
+        x = apply_domain_norm(
+            x,
+            DomainWhiten(
+                self.width, self.group_size, use_pallas=self.use_pallas,
+                whitener=self.whitener, **stem_kw
+            )
+            if self.whiten
+            else DomainBatchNorm(self.width, **stem_kw),
+            train,
+            self.num_domains,
+        )
+
+        block_cls = (
+            fnn.remat(TransformerBlockDWT, static_argnums=(2,))
+            if self.remat
+            else TransformerBlockDWT
+        )
+        # First quarter of blocks whiten (at least one), the rest BN —
+        # the ResNet stem+stage-1 recipe transplanted to depth.
+        whiten_depth = max(1, self.depth // 4)
+        for i in range(self.depth):
+            x = block_cls(
+                width=self.width,
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                use_whitening=(i < whiten_depth and self.whiten),
+                group_size=self.group_size,
+                num_domains=self.num_domains,
+                eval_domain=self.eval_domain,
+                momentum=self.momentum,
+                axis_name=self.axis_name,
+                dtype=self.dtype,
+                use_pallas=self.use_pallas,
+                whitener=self.whitener,
+                name=f"blk{i}",
+            )(x, train)
+
+        x = fnn.LayerNorm(dtype=self.dtype, name="ln_out")(x)
+        x = jnp.mean(x, axis=-2)  # mean pool over tokens → [B, C]
+        x = fnn.Dense(
+            padded_num_classes(self.num_classes, self.pad_classes_to),
+            dtype=self.dtype,
+            name="fc_out",
+        )(x)
+        x = x[..., : self.num_classes]  # no-op unless the head is padded
+
+        if train:
+            x = split_domains(x, self.num_domains)
+        return x

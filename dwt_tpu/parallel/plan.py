@@ -98,6 +98,20 @@ Rule = Tuple[str, P]
 #     names, not containers, so opt-state shards WITH its params);
 #   * remaining dense kernels [in, out]: out-feature sharded;
 #   * everything else (biases, norm affines, scalars): replicated.
+#
+# "fsdp" is the big-backbone table: EVERY weight-heavy kernel — conv,
+# fc, attention qkv/proj/mlp — shards over MODEL_AXIS, classifier heads
+# INCLUDED (pad-to-divisible ``pad_classes_to`` on the model makes the
+# head's out dim divisible; an indivisible head raises at plan time
+# naming the flag).  Because the rules match layer names, the Adam
+# ``mu``/``nu`` (and SGD ``trace``) moment twins shard identically to
+# their params BY CONSTRUCTION — per-host param+opt-state bytes drop to
+# ~1/model_axis of replicated (tools/shard_bench.py --preset fsdp).
+# The DWT contract is unchanged: whitening/BN running stats and the
+# eval whiten_cache stay REPLICATED (their cross-replica moment
+# averaging is the paper's algorithm).  Rule order is load-bearing:
+# the 4-D conv rule MUST precede the generic dense-kernel rule, or
+# P(None, model) would shard a conv kernel's kw dim.
 PRESETS = {
     "dp": [
         (r".*", P()),
@@ -107,6 +121,12 @@ PRESETS = {
         (r"\['(fc5|fc_out)'\]", P()),
         (r"conv\w*'\]\['kernel'\]", P(None, None, None, MODEL_AXIS)),
         (r"\['fc\w*'\]\['kernel'\]", P(None, MODEL_AXIS)),
+        (r".*", P()),
+    ],
+    "fsdp": [
+        (r"(\.|\[')(batch_stats|whiten_cache)", P()),
+        (r"conv\w*'\]\['kernel'\]", P(None, None, None, MODEL_AXIS)),
+        (r"'\]\['kernel'\]", P(None, MODEL_AXIS)),
         (r".*", P()),
     ],
 }
@@ -266,10 +286,66 @@ def _validate_spec(
                 )
             factor *= sizes[name]
         if shape[dim] % factor:
+            hint = ""
+            if dim == len(shape) - 1 and re.search(
+                r"fc_out|fc5|head", keypath
+            ):
+                # The one indivisible dim a user hits in practice: a
+                # classifier head whose out dim is num_classes (65, ...)
+                # under a model-sharding table.  Name the fix.
+                hint = (
+                    f" — this is a classifier head: pass --pad_classes_to "
+                    f"{factor} (model attr pad_classes_to) to pad "
+                    f"num_classes up to a multiple of {factor}; padded "
+                    "logit columns are sliced out inside the forward, so "
+                    "loss/accuracy/serve counters stay exact"
+                )
             raise ValueError(
                 f"sharding rule {pattern!r} shards dim {dim} of leaf "
                 f"{keypath} (shape {shape}) over {names} (size {factor}), "
-                f"which does not divide {shape[dim]}"
+                f"which does not divide {shape[dim]}{hint}"
+            )
+
+
+# Optimizer-moment containers whose leaves must shard exactly like the
+# parameter they update: Adam's mu/nu, SGD's momentum trace.  The marker
+# is an attribute access on a NamedTuple optax state, so the param twin
+# of ".opt_state[1].mu['conv1']['kernel']" is ".params['conv1']['kernel']".
+_MOMENT_MARKER = re.compile(r"\.(mu|nu|trace)(?=\[|\.|$)")
+
+
+def _check_moment_alignment(winners: dict, what: str) -> None:
+    """Fail fast on param/moment spec skew (the fsdp-table footgun).
+
+    A rules table that gives an optimizer-moment leaf a different spec
+    than its parameter silently corrupts the update math under GSPMD
+    (the elementwise optimizer still runs — each shard just pairs a
+    param block with the WRONG moment block's communication pattern and
+    pays a reshard every step, or worse under donation).  The table is
+    wrong, so the plan must refuse it, naming BOTH winning rules.
+    """
+    for keypath, (pattern, spec) in winners.items():
+        m = _MOMENT_MARKER.search(keypath)
+        if m is None:
+            continue
+        suffix = keypath[m.end():]
+        twin = None
+        for param_path in (".params" + suffix, "['params']" + suffix):
+            twin = winners.get(param_path)
+            if twin is not None:
+                break
+        if twin is None:
+            continue  # no param twin in this tree (e.g. a pruned subtree)
+        p_pattern, p_spec = twin
+        if p_spec != spec:
+            raise ValueError(
+                f"optimizer-moment spec skew in {what}: moment leaf "
+                f"{keypath} won rule {pattern!r} -> {spec}, but its "
+                f"parameter {param_path} won rule {p_pattern!r} -> "
+                f"{p_spec}.  Moments must shard WITH their params — "
+                "reorder the table or make the moment-matching rule "
+                "assign the param's spec (the presets do this by "
+                "matching layer names, not containers)"
             )
 
 
@@ -295,13 +371,17 @@ def match_partition_rules(
       won it — a dead rule is a table bug, silently doing nothing;
     * with ``mesh``, every winning spec is shape-validated against its
       leaf (rank fit + divisibility), raising with leaf, rule, and mesh
-      named.
+      named — an indivisible classifier head names ``--pad_classes_to``;
+    * an optimizer-moment leaf (``.mu``/``.nu``/``.trace``) whose winning
+      spec differs from its parameter's raises naming both rules
+      (param/moment spec skew corrupts the update math silently).
     """
     rules = list(rules)
     sizes = _axis_sizes(mesh)
     matched_any = [False] * len(rules)
     won_any = [False] * len(rules)
     shadow_example: dict = {}
+    winners: dict = {}
 
     def assign(path, leaf) -> P:
         keypath = jax.tree_util.keystr(path)
@@ -326,6 +406,7 @@ def match_partition_rules(
         pattern, spec = rules[winner]
         if sizes:
             _validate_spec(keypath, shape, spec, pattern, sizes)
+        winners[keypath] = (pattern, spec)
         return spec
 
     specs = jax.tree_util.tree_map_with_path(assign, tree)
@@ -337,6 +418,7 @@ def match_partition_rules(
                 "matches is claimed by an earlier rule (e.g. %s won by %r)",
                 pattern, what, example, winning,
             )
+    _check_moment_alignment(winners, what)
     return specs
 
 
@@ -870,8 +952,8 @@ def plan_from_flags(
             raise ValueError(
                 "--sharding_rules dp replicates every state leaf; a model "
                 f"axis of {shape[2]} would do nothing but waste chips — "
-                "pass a model-sharding rules table (preset 'model' or a "
-                "rules file)"
+                "pass a model-sharding rules table (preset 'model', "
+                "preset 'fsdp', or a rules file)"
             )
         # dp preset over an explicit mesh shape: the replica engine over
         # the equivalent (dcn, data) mesh — same programs as --dcn_slices.

@@ -263,6 +263,8 @@ class MultiHostAsyncCheckpointer(AsyncCheckpointer):
         # leaves back to replicated on the MAIN thread (it is a
         # collective) so host_fetch sees process-replicated arrays and
         # the on-disk shard format is unchanged by model parallelism.
+        # Gated by plan.uses_state_sharding (any sharded state axis), so
+        # fsdp-preset heads/moments (ISSUE-19) flow through unchanged.
         self._gather = gather
         # Saves are numbered by a per-host sequence counter (identical
         # across hosts: saves come from lockstep control flow).  The

@@ -54,7 +54,7 @@ from dwt_tpu.data import (
     prefetch_to_device,
     random_affine,
 )
-from dwt_tpu.nn import LeNetDWT, ResNetDWT
+from dwt_tpu.nn import LeNetDWT, ResNetDWT, build_backbone
 from dwt_tpu.ops.whitening import get_whitener
 from dwt_tpu.resilience import (
     AsyncCheckpointer,
@@ -1848,13 +1848,11 @@ def run_officehome(
     )
 
     def build_model(axis_name=None):
-        ctors = {
-            "resnet50": ResNetDWT.resnet50,
-            "resnet101": ResNetDWT.resnet101,
-            # single-block-per-stage architecture for smoke tests/CI
-            "tiny": lambda **kw: ResNetDWT(stage_sizes=(1, 1, 1, 1), **kw),
-        }
-        return ctors[cfg.arch](
+        # Registry lookup (dwt_tpu.nn.registry): --backbone wins over the
+        # legacy --arch names; every entry takes the same kwarg surface.
+        name = getattr(cfg, "backbone", None) or cfg.arch
+        return build_backbone(
+            name,
             num_classes=cfg.num_classes,
             group_size=cfg.group_size,
             momentum=cfg.running_momentum,
@@ -1863,6 +1861,7 @@ def run_officehome(
             whitener=getattr(cfg, "whitener", "cholesky"),
             dtype=compute_dtype,
             remat=cfg.remat,
+            pad_classes_to=getattr(cfg, "pad_classes_to", 0),
         )
 
     plan = _make_plan(cfg)

@@ -534,7 +534,11 @@ def host_fetch(state: Any, gather: Optional[Callable[[Any], Any]] = None) -> Any
     model-sharded leaves back to replicated, run HERE on the main thread
     (it is a collective) — so the host-shard on-disk format stays
     process-replicated no matter how the live state is placed, and both
-    formats remain readable by any plan.
+    formats remain readable by any plan.  The gate that threads it is
+    ``plan.uses_state_sharding`` — ANY sharded state axis, so the fsdp
+    preset's sharded heads and Adam moments (ISSUE-19) ride this path
+    with no new plumbing (cross-plan fsdp rows in
+    ``tests/test_sharding_plan.py``).
     """
     if gather is not None:
         state = gather(state)

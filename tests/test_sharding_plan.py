@@ -151,6 +151,68 @@ def test_model_preset_stat_and_opt_state_path_shapes():
     assert all(s == P() for s in stats)
 
 
+def test_fsdp_preset_path_shapes_and_moment_twins():
+    """The fsdp contract on real TrainState paths: EVERY conv/dense
+    kernel — including the head — shards over the model axis, the Adam
+    moments land on their params' specs by rule construction, and
+    whitening/BN running stats stay replicated (their cross-replica
+    averaging IS the paper's algorithm)."""
+    _, _, state = _lenet_state()
+    specs = match_partition_rules(PRESETS["fsdp"], state)
+    conv_dim = P(None, None, None, MODEL_AXIS)
+    fc_dim = P(None, MODEL_AXIS)
+    assert specs.params["conv1"]["kernel"] == conv_dim
+    assert specs.params["fc3"]["kernel"] == fc_dim
+    # The head shards too — the defining delta vs the model preset.
+    assert specs.params["fc5"]["kernel"] == fc_dim
+    for moments in (specs.opt_state[1].mu, specs.opt_state[1].nu):
+        assert moments["conv1"]["kernel"] == conv_dim
+        assert moments["fc5"]["kernel"] == fc_dim
+    assert all(
+        s == P() for s in jax.tree.leaves(
+            match_partition_rules(PRESETS["fsdp"], state.batch_stats)
+        )
+    )
+    # The save-side gather gates (sync + async ckpt) key off this.
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["fsdp"], name="fsdp"
+    )
+    assert plan.uses_state_sharding and plan.uses_model_axis
+
+
+def test_moment_spec_skew_raises_naming_both_rules():
+    """A table whose moment rule wins a different spec than the param's
+    rule must raise at plan time naming BOTH rules — silent param/moment
+    spec skew corrupts Adam updates."""
+    _, _, state = _lenet_state()
+    skewed = [
+        (r"\.(mu|nu)\[", P()),                       # moments: replicated
+        (r"conv\w*'\]\['kernel'\]", P(None, None, None, MODEL_AXIS)),
+        (r"'\]\['kernel'\]", P(None, MODEL_AXIS)),   # params: sharded
+        (r".*", P()),
+    ]
+    with pytest.raises(ValueError) as ei:
+        match_partition_rules(skewed, state, what="skewed table")
+    msg = str(ei.value)
+    assert "moment" in msg and "skewed table" in msg
+    assert "mu|nu" in msg                            # the moment's rule
+    assert "kernel" in msg                           # ...and the param's
+
+
+def test_indivisible_head_error_names_pad_flag():
+    """A model-axis rule on an indivisible classifier head must point at
+    the fix: the pad_classes_to flag, not just the arithmetic."""
+    mesh = make_plan_mesh((1, 4, 2))
+    plan = ShardingPlan.gspmd(
+        mesh, [(r"fc_out", P(None, MODEL_AXIS)), (r".*", P())], name="t"
+    )
+    with pytest.raises(ValueError) as ei:
+        plan.tree_specs({"fc_out": {"kernel": np.zeros((2048, 65))}})
+    msg = str(ei.value)
+    assert "does not divide 65" in msg
+    assert "pad_classes_to" in msg and "--pad_classes_to 2" in msg
+
+
 def test_no_match_raises_with_keystr_and_table():
     tree = {"params": {"conv9": {"kernel": np.zeros((3, 3, 4, 8))}}}
     with pytest.raises(ValueError) as ei:
@@ -372,15 +434,18 @@ def _host_shard_save(ckpt_dir, step, state):
         "host_shards",
     ],
 )
-def test_checkpoint_cross_plan_both_formats(tmp_path, fmt):
-    """Save under the dp plan, restore under the model-sharded plan (the
-    leaves must LAND already-sharded — restore-to-spec, no replicated
-    intermediate) and vice versa, for both on-disk formats."""
+@pytest.mark.parametrize("preset", ["model", "fsdp"])
+def test_checkpoint_cross_plan_both_formats(tmp_path, fmt, preset):
+    """Save under the dp plan, restore under the model-/fsdp-sharded
+    plan (the leaves must LAND already-sharded — restore-to-spec, no
+    replicated intermediate) and vice versa, for both on-disk formats.
+    The fsdp rows extend the PR-9 cross matrix: the head and moments
+    are sharded too, and the same gather-on-save path covers them."""
     from dwt_tpu.utils.checkpoint import restore_state, save_state
 
     _, _, state = _lenet_state()
     plan = ShardingPlan.gspmd(
-        make_plan_mesh((1, 4, 2)), PRESETS["model"], name="model"
+        make_plan_mesh((1, 4, 2)), PRESETS[preset], name=preset
     )
 
     # dp save -> model-sharded restore.
@@ -396,6 +461,13 @@ def test_checkpoint_cross_plan_both_formats(tmp_path, fmt):
     # Restore-to-spec proof: the restored leaf IS on its target sharding.
     assert kernel.sharding == shardings.params["conv1"]["kernel"]
     assert kernel.addressable_shards[0].data.shape[-1] == 16
+    if preset == "fsdp":
+        # fsdp's defining delta: head + Adam moments restore sharded.
+        head = restored.params["fc5"]["kernel"]
+        assert MODEL_AXIS in str(head.sharding.spec)
+        mu = restored.opt_state[1].mu["conv1"]["kernel"]
+        assert mu.sharding == shardings.opt_state[1].mu["conv1"]["kernel"]
+        assert MODEL_AXIS in str(mu.sharding.spec)
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(kernel)),
         np.asarray(state.params["conv1"]["kernel"]),
@@ -535,4 +607,85 @@ def test_model_sharded_train_step_lowers_for_tpu_offchip():
     )
     module = exp.mlir_module()
     assert "sharding" in module                       # SPMD annotations
+    assert exp.nr_devices == 8
+
+
+def test_fsdp_train_step_lowers_for_tpu_offchip():
+    """ISSUE-19 satellite: the fsdp-preset train step (params + moments
+    sharded over the model axis, stats replicated) must pass the full
+    TPU lowering off-chip at the (1, 4, 2) mesh — the Mosaic 2-D-dot
+    blocker class has bitten twice before."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+
+    model, tx, state = _lenet_state()
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["fsdp"], name="fsdp"
+    )
+    st_sh = plan.tree_shardings(state, "train state")
+    raw = make_digits_train_step(model, tx, 0.1, axis_name=None)
+    jitted = jax.jit(
+        raw,
+        in_shardings=(st_sh, plan.batch_sharding()),
+        out_shardings=(st_sh, plan.replicated),
+    )
+    batch = _batch()
+    exp = export.export(jitted, platforms=("tpu",))(
+        jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(
+                jnp.shape(l), jnp.asarray(l).dtype, sharding=s
+            ),
+            state, st_sh,
+        ),
+        jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                jnp.shape(l), jnp.asarray(l).dtype,
+                sharding=plan.batch_sharding(),
+            ),
+            batch,
+        ),
+    )
+    module = exp.mlir_module()
+    assert "sharding" in module
+    assert exp.nr_devices == 8
+
+
+def test_vit_fsdp_eval_forward_lowers_for_tpu_offchip():
+    """ISSUE-19 satellite: the ViT-DWT eval forward under the fsdp
+    preset (attention/MLP kernels + padded head on the model axis) must
+    pass the full TPU lowering off-chip at the (1, 4, 2) mesh."""
+    try:
+        from jax import export
+    except ImportError as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"missing jax.export: {e}")
+
+    from dwt_tpu.nn import build_backbone
+
+    model = build_backbone("vit_tiny", num_classes=65, pad_classes_to=2)
+    sample = jnp.zeros((3, 2, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), sample, True)
+    plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, 4, 2)), PRESETS["fsdp"], name="fsdp"
+    )
+    v_sh = plan.tree_shardings(variables, "vit variables")
+    fwd = jax.jit(
+        lambda v, x: model.apply(v, x, False),
+        in_shardings=(v_sh, plan.batch_sharding()),
+        out_shardings=plan.replicated,
+    )
+    exp = export.export(fwd, platforms=("tpu",))(
+        jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(
+                jnp.shape(l), jnp.asarray(l).dtype, sharding=s
+            ),
+            variables, v_sh,
+        ),
+        jax.ShapeDtypeStruct(
+            (8, 16, 16, 3), jnp.float32, sharding=plan.batch_sharding()
+        ),
+    )
+    module = exp.mlir_module()
+    assert "sharding" in module
     assert exp.nr_devices == 8

@@ -58,6 +58,9 @@ _DIRECTION_RULES: List[Tuple[str, str]] = [
     # bf16-over-f32 throughput ratio of one whitener backend (higher =
     # bf16 buys more), from tools/whitener_bench.py --compute_dtype.
     (r"_bf16_x", "up"),
+    # fsdp step A/B: ratio of fsdp-plan to dp-plan per-step wall — the
+    # ≤1.15x acceptance gate rides the generic band on this metric.
+    (r"_overhead_x$", "down"),
     (r"_bytes$", "down"),
     (r"(shed_rate|error_rate|errors|shed|lost)", "down"),
     # sampler_overhead_pct is deliberately absent: a ratio of two
@@ -189,6 +192,24 @@ def _extract_whitener_bench(rec: dict, out: Dict[str, float]) -> None:
             out[f"whitener_{name}_{key}"] = v
 
 
+def _extract_shard_bench(rec: dict, out: Dict[str, float]) -> None:
+    """tools/shard_bench.py --preset fsdp record: per-device
+    param+opt-state bytes under each preset (``_bytes`` → lower is
+    better) plus the fsdp-vs-dp reduction ratio and step overhead
+    (``fsdp_step_overhead_x`` → lower is better, gated ≤ 1.15 by the
+    acceptance band)."""
+    prefix = f"shard_{rec.get('model', 'bench')}"
+    for key, v in (rec.get("per_device") or {}).items():
+        v = _num(v)
+        if v is not None:
+            out[f"{prefix}_{key}"] = v
+    ab = rec.get("step_ab") or {}
+    for key in ("dp_step_ms", "fsdp_step_ms", "fsdp_step_overhead_x"):
+        v = _num(ab.get(key))
+        if v is not None:
+            out[f"{prefix}_{key}"] = v
+
+
 def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
     offered = rec.get("offered_imgs_per_s", "?")
     prefix = f"serve@{offered:g}" if isinstance(
@@ -257,6 +278,8 @@ def extract_metrics(records: List[dict]) -> Dict[str, float]:
             _extract_ckpt_bench(rec, out)
         elif kind == "serve_bench":
             _extract_serve_bench(rec, out)
+        elif kind == "shard_bench":
+            _extract_shard_bench(rec, out)
         elif kind == "whitener_bench":
             _extract_whitener_bench(rec, out)
         elif kind == "obs_report":

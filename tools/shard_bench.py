@@ -125,6 +125,143 @@ def _run_arm(arm: str, model_name: str, ckpt_dir: str) -> None:
     }))
 
 
+def _analytic_device_bytes(tree, specs, mesh) -> int:
+    """Per-device bytes for ``tree`` placed to ``specs`` on ``mesh``:
+    each leaf contributes its bytes divided by the product of the mesh
+    axes its spec shards over (replicated leaves contribute fully)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    total = 0.0
+    leaves = jax.tree.leaves(tree)
+    # isinstance, NOT hasattr(.index): optax states are NamedTuples,
+    # which also have .index and would be swallowed whole.
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda s: isinstance(s, P))
+    assert len(leaves) == len(spec_leaves)
+    for leaf, spec in zip(leaves, spec_leaves):
+        denom = int(np.prod([
+            sizes[a] for part in spec if part is not None
+            for a in ((part,) if isinstance(part, str) else part)
+        ] or [1]))
+        total += leaf.size * leaf.dtype.itemsize / denom
+    return int(total)
+
+
+def _bench_fsdp(model_name: str, steps: int) -> dict:
+    """The fsdp-preset arm: per-device param+opt-state bytes under
+    dp/model/fsdp (analytic over the real param tree via eval_shape —
+    resnet152 replicated x8 would not fit a CI host), a materialized
+    lenet cross-check of the analytic formula, and the fsdp-vs-dp
+    per-step A/B at equal data parallelism (the dp arm runs the SAME
+    axis-free program on a (1,4,1) prefix mesh; fsdp adds only the
+    model axis)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dwt_tpu.nn import LeNetDWT, build_backbone
+    from dwt_tpu.parallel import PRESETS, ShardingPlan, make_plan_mesh
+    from dwt_tpu.parallel.plan import match_partition_rules
+    from dwt_tpu.train import adam_l2, create_train_state, make_digits_train_step
+
+    shape = (1, jax.device_count() // 2, 2)
+    mesh = make_plan_mesh(shape)
+
+    # --- per-device state bytes over the REAL backbone param tree ---
+    # pad_classes_to=2 is the designed fsdp path for the 65-class head
+    # (the preset refuses an indivisible head, naming this flag).
+    tx = adam_l2(1e-3)
+    model = build_backbone(
+        model_name, group_size=4, num_classes=65, pad_classes_to=2
+    )
+    sample = jax.ShapeDtypeStruct((3, 2, 64, 64, 3), jnp.float32)
+    state_shapes = jax.eval_shape(
+        lambda s: create_train_state(model, jax.random.key(0), s, tx), sample
+    )
+    param_opt = (state_shapes.params, state_shapes.opt_state)
+    per_device = {}
+    for preset in ("dp", "model", "fsdp"):
+        specs = match_partition_rules(
+            PRESETS[preset], state_shapes, mesh=mesh,
+            what=f"{model_name} {preset}",
+        )
+        per_device[f"{preset}_param_opt_bytes"] = _analytic_device_bytes(
+            param_opt, (specs.params, specs.opt_state), mesh
+        )
+        if preset == "fsdp":
+            per_device["fsdp_stats_bytes"] = _analytic_device_bytes(
+                state_shapes.batch_stats, specs.batch_stats, mesh
+            )
+    per_device["fsdp_bytes_reduction_x"] = round(
+        per_device["dp_param_opt_bytes"]
+        / max(per_device["fsdp_param_opt_bytes"], 1), 3
+    )
+
+    # --- materialized cross-check: the analytic formula must agree with
+    # real addressable-shard bytes on a model small enough to place ---
+    lenet, ltx, lstate = _build("lenet")
+    fsdp_plan = ShardingPlan.gspmd(mesh, PRESETS["fsdp"], name="fsdp")
+    placed = fsdp_plan.place(lstate, "train state")
+    dev0 = mesh.devices.flat[0]
+    measured = 0
+    for leaf in jax.tree.leaves((placed.params, placed.opt_state)):
+        for s in leaf.addressable_shards:
+            if s.device == dev0:
+                measured += s.data.nbytes
+    lspecs = match_partition_rules(
+        PRESETS["fsdp"], lstate, mesh=mesh, what="lenet fsdp"
+    )
+    analytic = _analytic_device_bytes(
+        (lstate.params, lstate.opt_state),
+        (lspecs.params, lspecs.opt_state), mesh,
+    )
+    check_ok = abs(measured - analytic) <= 0.01 * analytic
+
+    # --- per-step A/B: the deployment question — the SAME devices and
+    # global batch, configured as pure DP ((1,n,1), the dp preset's own
+    # best layout) vs fsdp ((1,n/2,2)).  Anything else double-counts:
+    # dp ON a model-axis mesh computes every sample once per model
+    # replica, and a smaller dp mesh changes the simulation cost ---
+    rng = np.random.default_rng(0)
+    nb = shape[1] * 2
+    batch = {
+        "source_x": jnp.asarray(rng.normal(size=(nb, 28, 28, 1)), jnp.float32),
+        "source_y": jnp.asarray(rng.integers(0, 10, size=(nb,))),
+        "target_x": jnp.asarray(rng.normal(size=(nb, 28, 28, 1)), jnp.float32),
+    }
+    raw = make_digits_train_step(lenet, ltx, 0.1, axis_name=None)
+    dp_plan = ShardingPlan.gspmd(
+        make_plan_mesh((1, jax.device_count(), 1)), PRESETS["dp"], name="dp"
+    )
+    dp_ms = _median_step_ms(
+        dp_plan.make_train_step(raw),
+        dp_plan.place(lstate, "train state"),
+        dp_plan.shard_batch(batch), steps,
+    )
+    fsdp_ms = _median_step_ms(
+        fsdp_plan.make_train_step(raw), placed,
+        fsdp_plan.shard_batch(batch), steps,
+    )
+
+    return {
+        "kind": "shard_bench",
+        "preset": "fsdp",
+        "model": model_name,
+        "mesh_shape": list(shape),
+        "per_device": per_device,
+        "analytic_check_ok": bool(check_ok),
+        "step_ab": {
+            "devices": jax.device_count(),
+            "steps": steps,
+            "dp_step_ms": round(dp_ms, 2),
+            "fsdp_step_ms": round(fsdp_ms, 2),
+            "fsdp_step_overhead_x": round(fsdp_ms / dp_ms, 3),
+        },
+    }
+
+
 def _median_step_ms(step, state, batch, steps: int) -> float:
     import jax
 
@@ -195,6 +332,13 @@ def main(argv=None):
     p.add_argument("--model", choices=["lenet", "resnet50"], default="lenet")
     p.add_argument("--steps", type=int, default=30,
                    help="timed steps for the per-step A/B")
+    p.add_argument("--preset", choices=["fsdp"], default=None,
+                   help="fsdp: per-device param+opt-state bytes under "
+                        "dp/model/fsdp over the real backbone tree "
+                        "(default resnet152) + fsdp-vs-dp step A/B")
+    p.add_argument("--backbone", default="resnet152",
+                   help="registry entry for the --preset fsdp byte "
+                        "accounting (dwt_tpu.nn.registry)")
     p.add_argument("--arm", default=None,
                    help="(internal) subprocess restore arm")
     p.add_argument("--ckpt_dir", default=None,
@@ -217,6 +361,10 @@ def main(argv=None):
             + " --xla_force_host_platform_device_count=8"
         ).strip()
     env = dict(os.environ)
+
+    if args.preset == "fsdp":
+        print(json.dumps(_bench_fsdp(args.backbone, args.steps)))
+        return 0
 
     record = {"model": args.model, "restore": {}}
     with tempfile.TemporaryDirectory() as td:
