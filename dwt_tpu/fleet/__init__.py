@@ -14,6 +14,16 @@ windows regress.  :mod:`~dwt_tpu.fleet.balancer` (``dwt-fleet``) fronts
 N replica subprocesses with a least-outstanding-requests load balancer:
 per-replica health off ``/healthz``, 503/connect-error ejection with
 re-admission, SIGTERM → drain every replica → exit 0.
+:mod:`~dwt_tpu.fleet.autoscale` closes the capacity loop: an
+SLO-driven control loop scales the replica count between
+``--min_replicas``/``--max_replicas`` off the fleet's own aggregated
+signals, and the router weights picks by measured per-replica drain
+rate so heterogeneous fleets take proportional traffic.
+
+:class:`~dwt_tpu.fleet.autoscale.Autoscaler` is exported lazily (see
+``__getattr__``): importing it pulls in the balancer's serve-server
+dependency chain, which the lighter fleet consumers (watcher/canary
+users) should not pay for.
 """
 
 from dwt_tpu.fleet.canary import CanaryGate, CanaryVerdict, PostSwapMonitor
@@ -28,4 +38,14 @@ __all__ = [
     "PostSwapMonitor",
     "DeployController",
     "HotReloader",
+    "Autoscaler",
+    "ScaleDecision",
 ]
+
+
+def __getattr__(name):
+    if name in ("Autoscaler", "ScaleDecision"):
+        from dwt_tpu.fleet import autoscale
+
+        return getattr(autoscale, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

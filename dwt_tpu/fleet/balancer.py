@@ -9,7 +9,16 @@ replica by replica with the canary gating each one independently).
   picks the healthy replica with the fewest requests currently in
   flight through the balancer (the cheapest load signal that tracks the
   replicas' actual queue depth without polling them per request); ties
-  break round-robin.
+  break round-robin.  With autoscaling on (the default), the pick is
+  WEIGHTED by each replica's measured drain-rate EWMA so heterogeneous
+  replicas take proportional traffic, and ``--session_affinity`` adds
+  consistent-hash pinning on the ``X-DWT-Session`` header (see
+  :class:`ReplicaSet`).
+* **autoscaling** — :class:`~dwt_tpu.fleet.autoscale.Autoscaler`
+  samples queue depth, shed rate, and p99-vs-SLO on a
+  ``--scale_interval_s`` cadence and drives the replica count between
+  ``--min_replicas`` and ``--max_replicas``; ``--no-autoscale`` pins
+  the legacy fixed-N fleet bit for bit.
 * **health** — a prober thread polls each replica's ``/healthz`` every
   ``--health_interval_s``: a non-200 (the server answers 503 with a dead
   dispatcher), a connect failure, or a dead subprocess EJECTS the
@@ -31,9 +40,12 @@ replica by replica with the canary gating each one independently).
 from __future__ import annotations
 
 import argparse
+import bisect
+import hashlib
 import http.client
 import json
 import logging
+import os
 import select
 import signal
 import subprocess
@@ -106,6 +118,14 @@ class Replica:
         self.failures = 0          # lifetime proxy/probe failures
         self.respawns = 0          # times this slot was re-spawned
         self.last_health: dict = {}
+        # Autoscaler scale-down: a retiring replica is out of routing
+        # for good (the prober neither re-admits nor respawns it) while
+        # its own SIGTERM drain finishes the queue.
+        self.retiring = False
+        # Drain-rate EWMA (completions/s off the balancer's pooled
+        # accounting) — the weighted router's signal.  None = cold.
+        self.rate_ewma: Optional[float] = None
+        self._last_done_t: Optional[float] = None
 
     def replace_process(self, proc: subprocess.Popen, port: int,
                         timeout: float = 70.0) -> None:
@@ -134,18 +154,63 @@ class Replica:
             "rid": self.rid, "port": self.port, "pid": self.pid,
             "healthy": self.healthy, "outstanding": self.outstanding,
             "served": self.served, "failures": self.failures,
-            "respawns": self.respawns,
+            "respawns": self.respawns, "retiring": self.retiring,
+            "drain_rate": (round(self.rate_ewma, 3)
+                           if self.rate_ewma is not None else None),
             "version": self.last_health.get("version"),
         }
 
 
-class ReplicaSet:
-    """Routing + health state over the fleet's replicas."""
+_RING_VNODES = 64  # virtual nodes per replica on the affinity ring
 
-    def __init__(self, replicas: Sequence[Replica]):
+
+def _ring_hash(key: str) -> int:
+    return int(hashlib.md5(key.encode()).hexdigest()[:16], 16)
+
+
+class ReplicaSet:
+    """Routing + health state over the fleet's replicas.
+
+    ``weighted=False`` (the default, and what ``--no-autoscale`` pins)
+    is the PR-12 router unchanged: fewest outstanding, ties round-robin.
+
+    ``weighted=True`` scores each healthy replica by
+    ``(outstanding + 1) / weight`` where weight is its drain-rate EWMA
+    (completions/s measured at :meth:`release` off the balancer's own
+    pooled accounting — no extra polling).  A heterogeneous fleet (bf16
+    next to f32, int8 next to full precision, different batch delays)
+    thus takes traffic proportional to what it actually drains.  Cold
+    replicas (fresh spawn, < ``cold_min_served`` completions) weigh in
+    at the fleet mean so they warm up without being dogpiled; warm
+    stragglers are floored at 5% of the fastest so a wedged-but-healthy
+    replica cannot starve to a weight of zero and hide from the prober;
+    ejected replicas are out of the healthy set entirely — weight 0 by
+    construction.
+
+    ``session_affinity=True`` adds consistent-hash pinning: a request
+    carrying ``X-DWT-Session`` routes to its key's ring owner
+    (``_RING_VNODES`` virtual nodes per replica, ring rebuilt only on
+    membership change, so pins survive ejection/readmission cycles).
+    An ejected owner degrades that key to the weighted pick until it
+    returns; a retired/removed owner remaps the key's arc permanently.
+    Pinned picks bypass the load score by design — affinity trades
+    balance for stickiness.
+    """
+
+    def __init__(self, replicas: Sequence[Replica],
+                 weighted: bool = False,
+                 session_affinity: bool = False,
+                 cold_min_served: int = 8,
+                 clock=time.monotonic):
         self.replicas = list(replicas)
+        self.weighted = bool(weighted)
+        self.session_affinity = bool(session_affinity)
+        self.cold_min_served = int(cold_min_served)
+        self._clock = clock
         self._lock = threading.Lock()
         self._rr = 0
+        self._ring: List[tuple] = []  # sorted [(hash, replica)]
+        self._rebuild_ring_locked()
         # Live metrics plane: balancer-level series (the per-replica
         # serving series ride the /metrics aggregation with a replica
         # label — see _BalancerHandler).
@@ -163,17 +228,69 @@ class ReplicaSet:
             labelnames=("rid",),
         )
 
-    def pick(self) -> Optional[Replica]:
-        """Healthy replica with the fewest outstanding proxied requests
-        (ties round-robin); reserves a slot (caller MUST release)."""
+    # ------------------------------------------------------------ routing
+
+    def _rebuild_ring_locked(self) -> None:
+        ring = []
+        for r in self.replicas:
+            if r.retiring:
+                continue
+            for v in range(_RING_VNODES):
+                ring.append((_ring_hash(f"{r.rid}#{v}"), r))
+        ring.sort(key=lambda t: t[0])
+        self._ring = ring
+
+    def _ring_owner_locked(self, key: str) -> Optional[Replica]:
+        if not self._ring:
+            return None
+        h = _ring_hash(key)
+        idx = bisect.bisect_right([t[0] for t in self._ring], h)
+        return self._ring[idx % len(self._ring)][1]
+
+    def _weight_locked(self, r: Replica,
+                       healthy: List[Replica]) -> float:
+        known = [x.rate_ewma for x in healthy
+                 if x.rate_ewma is not None
+                 and x.served >= self.cold_min_served]
+        if r.rate_ewma is None or r.served < self.cold_min_served:
+            # Cold replica: fleet-mean weight — takes a fair share to
+            # warm up, neither dogpiled nor starved.
+            return sum(known) / len(known) if known else 1.0
+        return max(r.rate_ewma, 0.05 * max(known))
+
+    def pick(self, session_key: Optional[str] = None) -> Optional[Replica]:
+        """A healthy replica, slot reserved (caller MUST release).
+
+        Unweighted: fewest outstanding, ties round-robin.  Weighted:
+        argmin of ``(outstanding + 1) / drain-rate weight``, ties
+        round-robin.  A ``session_key`` (affinity enabled) pins to the
+        ring owner while that owner is healthy."""
         with self._lock:
             healthy = [r for r in self.replicas if r.healthy]
             if not healthy:
                 return None
-            least = min(r.outstanding for r in healthy)
-            tied = [r for r in healthy if r.outstanding == least]
-            choice = tied[self._rr % len(tied)]
-            self._rr += 1
+            choice = None
+            if session_key is not None and self.session_affinity:
+                owner = self._ring_owner_locked(session_key)
+                if owner is not None and owner.healthy:
+                    choice = owner
+            if choice is None:
+                if not self.weighted:
+                    least = min(r.outstanding for r in healthy)
+                    tied = [r for r in healthy
+                            if r.outstanding == least]
+                else:
+                    w = {id(r): self._weight_locked(r, healthy)
+                         for r in healthy}
+                    scores = {
+                        id(r): (r.outstanding + 1) / w[id(r)]
+                        for r in healthy
+                    }
+                    best = min(scores.values())
+                    tied = [r for r in healthy
+                            if scores[id(r)] == best]
+                choice = tied[self._rr % len(tied)]
+                self._rr += 1
             choice.outstanding += 1
             return choice
 
@@ -182,6 +299,19 @@ class ReplicaSet:
             replica.outstanding = max(0, replica.outstanding - 1)
             if ok:
                 replica.served += 1
+                # Drain-rate EWMA off the completion stream: the gap
+                # between successive completions is 1/rate regardless
+                # of how many were in flight — exactly the replica's
+                # measured throughput through this balancer.
+                now = self._clock()
+                last = replica._last_done_t
+                replica._last_done_t = now
+                if last is not None and now > last:
+                    inst = 1.0 / (now - last)
+                    replica.rate_ewma = (
+                        inst if replica.rate_ewma is None
+                        else 0.8 * replica.rate_ewma + 0.2 * inst
+                    )
 
     def eject(self, replica: Replica, reason: str) -> None:
         with self._lock:
@@ -199,6 +329,35 @@ class ReplicaSet:
                 return
             replica.healthy = True
         log.info("fleet: replica %d re-admitted", replica.rid)
+
+    # ------------------------------------------- autoscaler membership
+
+    def retire(self, replica: Replica) -> None:
+        """Pull a replica from routing for scale-down.  NOT an eject:
+        no failure charge, no ejection metric, and the prober skips it
+        entirely — its exit is expected, not a health event.  Its arc
+        of the affinity ring remaps now (the pin is gone for good)."""
+        with self._lock:
+            replica.retiring = True
+            replica.healthy = False
+            self._rebuild_ring_locked()
+        log.info("fleet: replica %d retiring (scale-down)", replica.rid)
+
+    def add(self, replica: Replica) -> None:
+        """Admit a freshly scaled-up replica to routing."""
+        with self._lock:
+            self.replicas.append(replica)
+            self._rebuild_ring_locked()
+        log.info("fleet: replica %d added on port %d",
+                 replica.rid, replica.port)
+
+    def remove(self, replica: Replica) -> None:
+        """Drop a retired replica's slot once its drain finished."""
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r is not replica]
+            self._rebuild_ring_locked()
+        replica.pool.close_all()
 
     def healthy_count(self) -> int:
         with self._lock:
@@ -268,6 +427,13 @@ class Respawner:
             "dwt_fleet_respawns_total",
             "replica subprocess respawns", labelnames=("rid",),
         )
+
+    def exhausted_slots(self) -> List[int]:
+        """Replica slots whose respawn budget is spent — the
+        autoscaler's crash-loop guard reads this: while any slot is
+        exhausted, rising load-per-replica is a dying config, not
+        demand, and scale-up is refused."""
+        return sorted(self._budget.exhausted_keys())
 
     def maybe_respawn(self, replica: Replica) -> bool:
         """Called by the prober on a dead replica.  Quick no-op while a
@@ -352,7 +518,12 @@ class HealthProber(threading.Thread):
         self._stop_evt = threading.Event()
 
     def probe_once(self) -> None:
-        for r in self.replicas.replicas:
+        # Snapshot: the autoscaler adds/removes replicas concurrently.
+        for r in list(self.replicas.replicas):
+            if r.retiring:
+                # A retiring replica is draining toward an EXPECTED
+                # exit: not a health event, never a respawn candidate.
+                continue
             if not r.alive:
                 self.replicas.eject(
                     r, f"process exited rc={r.proc.returncode}"
@@ -416,6 +587,7 @@ class HealthProber(threading.Thread):
 # --------------------------------------------------------------- HTTP front
 
 _PROXIED = None
+_SHED = None
 
 
 def _proxied_counter():
@@ -429,6 +601,18 @@ def _proxied_counter():
     return _PROXIED
 
 
+def _shed_counter():
+    global _SHED
+    if _SHED is None:
+        _SHED = get_registry().counter(
+            "dwt_fleet_shed_total",
+            "front-door shed responses (replica 429/503 passthrough + "
+            "no-healthy-replica 503s) — the autoscaler's shed-rate "
+            "signal",
+        )
+    return _SHED
+
+
 class _BalancerHandler(DrainAwareHandler):
     """The balancer's front end: the serve handler's keep-alive/drain
     behavior (shared :class:`~dwt_tpu.serve.server.DrainAwareHandler`
@@ -437,15 +621,40 @@ class _BalancerHandler(DrainAwareHandler):
 
     # Set by make_handler:
     replicas: ReplicaSet = None       # type: ignore[assignment]
+    autoscaler = None                 # Optional[Autoscaler]
 
     def log_message(self, fmt, *args):
         log.debug("balancer http: " + fmt, *args)
 
     # -------------------------------------------------------------- proxy
 
+    def _scaling_eta_s(self) -> Optional[float]:
+        """Expected-capacity ETA while the autoscaler has capacity in
+        motion (spawn in flight, post-scale-up cooldown, or pressure at
+        --max_replicas), else None."""
+        a = self.autoscaler
+        if a is None:
+            return None
+        try:
+            return a.advise_eta_s()
+        except Exception:
+            return None
+
+    def _retry_after_s(self, default_s: float) -> float:
+        """The Retry-After to advise on a shed.  The queue-depth
+        default assumes fixed capacity; while a scale-up is the thing
+        actually being waited on, advising less than its ETA
+        synchronizes client retries into a thundering herd that lands
+        BEFORE the new replica does — advise the larger of the two."""
+        eta = self._scaling_eta_s()
+        if eta is None:
+            return default_s
+        return max(default_s, eta)
+
     def _proxy(self, method: str, path: str, body: Optional[bytes],
-               headers: dict) -> None:
-        """Forward one request to the least-loaded healthy replica over a
+               headers: dict,
+               session_key: Optional[str] = None) -> None:
+        """Forward one request to the chosen healthy replica over a
         pooled keep-alive connection; on a connect/send failure (request
         never reached the replica) eject it and retry the next one —
         bounded by the fleet size.  A failure AFTER the send is surfaced,
@@ -453,12 +662,13 @@ class _BalancerHandler(DrainAwareHandler):
         tried = 0
         total = len(self.replicas.replicas)
         while tried < total:
-            replica = self.replicas.pick()
+            replica = self.replicas.pick(session_key=session_key)
             if replica is None:
                 break
             tried += 1
             conn = replica.pool.get()
             sent = False
+            t0 = time.monotonic()
             try:
                 conn.request(method, path, body=body, headers=headers)
                 sent = True
@@ -480,26 +690,42 @@ class _BalancerHandler(DrainAwareHandler):
                     })
                     return
                 self.replicas.eject(replica, f"proxy connect failed: {e}")
+                # A pinned pick that failed to connect degrades to the
+                # weighted/least-outstanding retry (the eject above
+                # takes the owner out of the healthy set).
                 continue  # safe retry on another replica
             replica.pool.put(conn)
             self.replicas.release(replica, ok=resp.status == 200)
             _proxied_counter().labels(
                 status=f"{resp.status // 100}xx"
             ).inc()
+            a = self.autoscaler
+            if resp.status == 200 and a is not None:
+                a.note_latency((time.monotonic() - t0) * 1e3)
+            retry_after = resp.getheader("Retry-After")
+            if resp.status in (429, 503):
+                _shed_counter().inc()
+                # An upstream shed's advice also assumes fixed
+                # capacity; while scaling, stretch it to the ETA.
+                eta = self._scaling_eta_s()
+                if eta is not None:
+                    upstream = float(retry_after or 0.0)
+                    retry_after = str(int(max(upstream, eta) + 0.5))
             self.send_response(resp.status)
             self.send_header("Content-Type", "application/jsonl")
             self.send_header("Content-Length", str(len(data)))
-            retry_after = resp.getheader("Retry-After")
             if retry_after:
-                self.send_header("Retry-After", retry_after)
+                self.send_header("Retry-After", str(retry_after))
             self.send_header("X-DWT-Replica", str(replica.rid))
             self.end_headers()
             self.wfile.write(data)
             return
+        _shed_counter().inc()
+        advise_s = self._retry_after_s(1.0)
         self._reply(503, {
             "error": "no healthy replica",
-            "retry_after_ms": 1000,
-        }, headers=[("Retry-After", "1")])
+            "retry_after_ms": int(advise_s * 1000),
+        }, headers=[("Retry-After", str(int(advise_s + 0.5)))])
 
     def do_POST(self):
         body = self.read_body()  # ALWAYS, even on error paths (keep-alive)
@@ -511,16 +737,27 @@ class _BalancerHandler(DrainAwareHandler):
                 "error": "draining", "retry_after_ms": 1000,
             }, headers=[("Retry-After", "1")])
             return
+        session_key = None
+        if self.replicas.session_affinity:
+            session_key = self.headers.get("X-DWT-Session") or None
         self._proxy("POST", self.path, body,
-                    {"Content-Type": "application/json"})
+                    {"Content-Type": "application/json"},
+                    session_key=session_key)
 
     def do_GET(self):
         if self.path == "/healthz":
             healthy = self.replicas.healthy_count()
+            a = self.autoscaler
             self._reply(200 if healthy > 0 else 503, {
                 "ok": healthy > 0,
                 "draining": bool(self.draining.is_set()),
                 "healthy_replicas": healthy,
+                # The autoscaler's desired count (= healthy once every
+                # spawn/drain settles): the ramp bench stamps its
+                # time-to-first-scale-up off this.
+                "target_replicas": (a.target if a is not None
+                                    else len(self.replicas.replicas)),
+                "autoscale": a is not None,
                 "replicas": self.replicas.describe(),
             })
         elif self.path == "/stats":
@@ -597,23 +834,54 @@ class _BalancerHandler(DrainAwareHandler):
         )
 
 
-def make_handler(replicas: ReplicaSet, draining: threading.Event):
+def make_handler(replicas: ReplicaSet, draining: threading.Event,
+                 autoscaler=None):
     return type("BalancerHandler", (_BalancerHandler,), {
         "replicas": replicas, "draining": draining,
+        "autoscaler": autoscaler,
     })
 
 
 # ------------------------------------------------------------ fleet spawn
+
+def _per_replica_argv(rid: int, serve_argv: List[str]) -> List[str]:
+    """Rewrite ``--access_log PATH`` to ``PATH.r<rid>`` so every replica
+    owns its own access-log trail (the file opens in append mode, so a
+    respawn of the same slot continues the slot's history).  Without
+    this, N replicas interleave writes into one JSONL and every
+    retirement-audit assertion is meaningless."""
+    argv = list(serve_argv)
+    for i, arg in enumerate(argv):
+        if arg == "--access_log" and i + 1 < len(argv):
+            argv[i + 1] = f"{argv[i + 1]}.r{rid}"
+            break
+        if arg.startswith("--access_log="):
+            argv[i] = f"{arg}.r{rid}"
+            break
+    return argv
+
 
 def spawn_replica(rid: int, serve_argv: List[str],
                   host: str = "127.0.0.1",
                   ready_timeout_s: float = 300.0) -> Replica:
     """Start one ``dwt-serve`` subprocess on an ephemeral port and wait
     for its ``serve_ready`` line (which carries the bound port)."""
+    from dwt_tpu.resilience import inject
+
     cmd = [sys.executable, "-m", "dwt_tpu.serve.server",
-           "--host", host, "--port", "0", *serve_argv]
+           "--host", host, "--port", "0",
+           *_per_replica_argv(rid, serve_argv)]
+    env = None
+    slow_plan = inject.take_replica_slow(rid)
+    if slow_plan is not None:
+        # The straggler fault rides the replica's own env (the sweep
+        # supervisor's take_sweep_job_fault pattern): this replica's
+        # dispatcher sleeps per batch, the fleet process stays clean.
+        env = dict(os.environ)
+        env[inject.ENV_VAR] = json.dumps(slow_plan)
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env,
     )
     deadline = time.monotonic() + ready_timeout_s
     line = ""
@@ -701,6 +969,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--respawn_backoff_s", type=float, default=1.0,
                    help="base respawn backoff; attempt k waits "
                         "backoff * 2^(k-1) after the previous attempt")
+    # ------------------------------------------------- autoscaling
+    p.add_argument("--no-autoscale", dest="no_autoscale",
+                   action="store_true",
+                   help="kill switch: fixed-N fleet with the legacy "
+                        "least-outstanding round-robin-tie router (no "
+                        "control loop, no weighted routing)")
+    p.add_argument("--min_replicas", type=int, default=None,
+                   help="autoscaler floor (default: --replicas)")
+    p.add_argument("--max_replicas", type=int, default=None,
+                   help="autoscaler ceiling (default: --replicas — "
+                        "i.e. pinned unless raised)")
+    p.add_argument("--scale_interval_s", type=float, default=2.0,
+                   help="control-loop sampling cadence")
+    p.add_argument("--scale_cooldown_s", type=float, default=15.0,
+                   help="refractory period after every scale action")
+    p.add_argument("--scale_pressure", type=float, default=4.0,
+                   help="scale-up pressure threshold: queued + "
+                        "outstanding requests per healthy replica")
+    p.add_argument("--scale_idle", type=float, default=0.5,
+                   help="scale-down idle threshold (same units)")
+    p.add_argument("--scale_pressure_for_s", type=float, default=4.0,
+                   help="pressure must hold this long before scale-up "
+                        "(rules-engine hysteresis, not raw samples)")
+    p.add_argument("--scale_idle_for_s", type=float, default=20.0,
+                   help="idle must hold this long before scale-down")
+    p.add_argument("--scale_shed_per_s", type=float, default=0.5,
+                   help="scale-up when the front door sheds more than "
+                        "this many requests/s (sustained)")
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help=">0: scale-up when the proxied p99 exceeds "
+                        "this SLO (sustained)")
+    p.add_argument("--scale_up_max", type=int, default=8,
+                   help="scale-up attempt budget (successful spawns "
+                        "are refunded; crash-looping ones are not)")
+    p.add_argument("--session_affinity", action="store_true",
+                   help="pin X-DWT-Session keys to a consistent-hash "
+                        "ring owner (degrades to weighted routing "
+                        "while the owner is ejected)")
     return p
 
 
@@ -715,6 +1021,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(own)
     if args.replicas < 1:
         raise SystemExit("dwt-fleet: need at least one replica")
+    min_replicas = (args.replicas if args.min_replicas is None
+                    else args.min_replicas)
+    max_replicas = (args.replicas if args.max_replicas is None
+                    else args.max_replicas)
+    if not args.no_autoscale and not (
+            1 <= min_replicas <= args.replicas <= max_replicas):
+        raise SystemExit(
+            f"dwt-fleet: need 1 <= --min_replicas ({min_replicas}) <= "
+            f"--replicas ({args.replicas}) <= --max_replicas "
+            f"({max_replicas})"
+        )
 
     replicas = []
     try:
@@ -725,7 +1042,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if r.proc is not None:
                 r.proc.kill()
         raise
-    rset = ReplicaSet(replicas)
+    # --no-autoscale pins the PR-12 fleet bit for bit: unweighted
+    # least-outstanding routing, fixed N, no control loop.
+    rset = ReplicaSet(
+        replicas,
+        weighted=not args.no_autoscale,
+        session_affinity=args.session_affinity,
+    )
     respawner = None
     if args.respawn_max > 0:
         respawner = Respawner(
@@ -740,6 +1063,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     prober.start()
 
+    autoscaler = None
+    if not args.no_autoscale:
+        from dwt_tpu.fleet.autoscale import Autoscaler
+
+        autoscaler = Autoscaler(
+            rset,
+            spawn_fn=lambda rid: spawn_replica(rid, serve_argv, args.host),
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            interval_s=args.scale_interval_s,
+            pressure_hi=args.scale_pressure,
+            idle_lo=args.scale_idle,
+            pressure_for_s=args.scale_pressure_for_s,
+            idle_for_s=args.scale_idle_for_s,
+            cooldown_s=args.scale_cooldown_s,
+            shed_hi_per_s=args.scale_shed_per_s,
+            slo_p99_ms=args.slo_p99_ms,
+            scale_up_max=args.scale_up_max,
+            respawner=respawner,
+            events=lambda rec: print(json.dumps(rec), flush=True),
+        )
+        autoscaler.start()
+
     draining = threading.Event()
 
     def _handle(signum, frame):  # flag-only (resilience handler pattern)
@@ -752,7 +1098,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         daemon_threads = False
 
     httpd = _Server(
-        (args.host, args.port), make_handler(rset, draining)
+        (args.host, args.port),
+        make_handler(rset, draining, autoscaler=autoscaler),
     )
     http_thread = threading.Thread(
         target=httpd.serve_forever, name="dwt-fleet-http", daemon=True
@@ -761,6 +1108,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     print(json.dumps({
         "kind": "fleet_ready",
         "host": args.host, "port": httpd.server_address[1],
+        "autoscale": autoscaler is not None,
+        "min_replicas": min_replicas, "max_replicas": max_replicas,
         "replicas": [
             {"rid": r.rid, "port": r.port, "pid": r.pid}
             for r in replicas
@@ -770,19 +1119,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     draining.wait()
     log.info("fleet drain: SIGTERM/SIGINT received")
     # Half-close order mirrors the single server: stop admitting (the
-    # handler answers 503 + Retry-After), stop health probes (a replica
-    # mid-drain answering nothing is not a health event), drain every
-    # replica's own queue via ITS SIGTERM path, then stop the front end.
+    # handler answers 503 + Retry-After), stop the control loop (a
+    # fleet-wide drain must not race a scale decision), stop health
+    # probes (a replica mid-drain answering nothing is not a health
+    # event), drain every replica's own queue via ITS SIGTERM path,
+    # then stop the front end.
+    if autoscaler is not None:
+        autoscaler.stop()
     prober.stop()
-    bad = drain_fleet(replicas)
+    # The autoscaler may have grown/shrunk the fleet: drain the LIVE
+    # membership, not the boot-time list (a retired slot mid-drain is
+    # still in rset until its exit is verified — SIGTERMing it again
+    # is idempotent).
+    bad = drain_fleet(list(rset.replicas))
     httpd.shutdown()
     http_thread.join(timeout=10)
     httpd.server_close()
-    print(json.dumps({
+    summary = {
         "kind": "fleet_summary",
         "replicas": rset.describe(),
         "unclean_drains": bad,
-    }), flush=True)
+    }
+    if autoscaler is not None:
+        summary["target_replicas"] = autoscaler.target
+    print(json.dumps(summary), flush=True)
     return 0 if bad == 0 else 1
 
 

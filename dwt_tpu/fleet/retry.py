@@ -20,7 +20,7 @@ tests drive the backoff deterministically.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Hashable
+from typing import Callable, Dict, Hashable, List
 
 
 class RespawnBudget:
@@ -69,6 +69,23 @@ class RespawnBudget:
             self._clock() + self.backoff_s * (2 ** attempts)
         )
         return attempts + 1
+
+    def exhausted_keys(self) -> List[Hashable]:
+        """Every key whose budget is spent — the autoscaler's crash-loop
+        guard: while any replica slot is exhausted, extra capacity is a
+        config problem wearing a load costume, and scale-up is refused."""
+        return [k for k, n in self._attempts.items()
+                if n >= self.max_attempts]
+
+    def forgive(self, key: Hashable) -> None:
+        """Refund one attempt after a demonstrated success (a scaled-up
+        replica that reached healthy).  Keeps the budget a *crash* budget:
+        sustained legitimate growth never exhausts it, a crash loop —
+        where no attempt is ever forgiven — still does."""
+        n = self._attempts.get(key, 0)
+        if n > 0:
+            self._attempts[key] = n - 1
+            self._exhausted_seen.discard(key)
 
     def restore(self, key: Hashable, attempts: int) -> None:
         """Seed a key's attempt count (a relaunched supervisor adopting

@@ -69,6 +69,16 @@ production code at exactly the points the real fault would strike:
   three out of the stat accumulator while serving stays healthy.  The
   kinds compose (drift first — the world moved — then poison rides the
   drifted stream).
+* fleet-traffic kinds (``dwt_tpu/fleet``): ``traffic_spike`` multiplies
+  serve_bench's offered Poisson rate by ``factor`` from request index
+  ``at_request`` onward — a step change in demand, persistent like
+  drift (a spike is the new steady state until the autoscaler absorbs
+  it).  ``take_replica_slow(rid)`` yields a per-replica
+  ``DWT_FAULT_PLAN`` the fleet injects into that replica's spawn env
+  (the ``take_sweep_job_fault`` pattern); inside the replica,
+  ``maybe_replica_slow()`` sleeps the dispatcher ``sleep_s`` per batch
+  — a straggler, not a corpse: it answers health probes and serves,
+  just slowly, so the weighted router (not the prober) must starve it.
 * :class:`FlakyDataset` — the in-process form: chosen indices raise for
   the first N accesses (transient I/O) or always (corrupt item), hang
   forever on their first access (``dead_worker_at`` — the pool worker
@@ -216,6 +226,18 @@ class FaultPlan:
     # shift.  Persistent (NOT one-shot): a domain shift is a new steady
     # state the adapter must keep seeing until it adapts.
     serve_drift_shift: Optional[Dict[str, Any]] = None
+    # --- fleet-traffic faults (dwt_tpu/fleet) --------------------------
+    # {"at_request": N, "factor": f} — from request index N onward,
+    # serve_bench's Poisson inter-arrival gaps divide by ``factor``: a
+    # step change in offered rate.  Persistent like drift: a traffic
+    # spike is the new steady state until capacity absorbs it.
+    traffic_spike: Optional[Dict[str, Any]] = None
+    # {"rid": R, "sleep_s": s} — replica R's dispatcher sleeps ``s``
+    # seconds per batch: a straggler (answers probes, serves slowly),
+    # not a corpse.  The fleet consumes this via take_replica_slow(rid)
+    # at spawn time (one-shot per arm: a respawn of the straggler runs
+    # clean); inside the replica the sleep itself is persistent.
+    replica_slow_at: Optional[Dict[str, Any]] = None
 
     _FIELDS = (
         "nan_at_step", "crash_in_save", "hang_at_step", "slow_step_at",
@@ -224,7 +246,7 @@ class FaultPlan:
         "missing_parent_blob", "dead_worker_at", "slow_item_at",
         "slow_item_s", "kill_supervisor_at_schedule", "sweep_preempt_pairs",
         "sweep_job_kill_mid_save", "serve_poison_requests",
-        "serve_drift_shift",
+        "serve_drift_shift", "traffic_spike", "replica_slow_at",
     )
 
     @classmethod
@@ -438,6 +460,71 @@ class FaultPlan:
                 "offset": float(offset),
                 "scale": float(scale),
             }
+        spike = spec.get("traffic_spike")
+        if spike is not None:
+            if not isinstance(spike, dict):
+                raise ValueError(
+                    f"{ENV_VAR}: traffic_spike must be an object like "
+                    '{"at_request": N, "factor": f}; '
+                    f"got {spike!r}"
+                )
+            bad_keys = sorted(set(spike) - {"at_request", "factor"})
+            if bad_keys:
+                raise ValueError(
+                    f"{ENV_VAR}: unknown traffic_spike key(s) {bad_keys}; "
+                    "valid: ['at_request', 'factor']"
+                )
+            at = spike.get("at_request", 0)
+            if isinstance(at, bool) or not isinstance(at, int) or at < 0:
+                raise ValueError(
+                    f"{ENV_VAR}: traffic_spike.at_request must be a "
+                    f"0-based request index >= 0; got {at!r}"
+                )
+            factor = spike.get("factor")
+            if isinstance(factor, bool) or not isinstance(
+                    factor, (int, float)) or not math.isfinite(factor) \
+                    or factor <= 0:
+                raise ValueError(
+                    f"{ENV_VAR}: traffic_spike.factor must be a finite "
+                    f"number > 0; got {factor!r}"
+                )
+            if float(factor) == 1.0:
+                raise ValueError(
+                    f"{ENV_VAR}: traffic_spike with factor=1 is the "
+                    "identity — a rate step that steps nowhere proves "
+                    "nothing"
+                )
+            spike = {"at_request": at, "factor": float(factor)}
+        slow_replica = spec.get("replica_slow_at")
+        if slow_replica is not None:
+            if not isinstance(slow_replica, dict):
+                raise ValueError(
+                    f"{ENV_VAR}: replica_slow_at must be an object like "
+                    '{"rid": R, "sleep_s": s}; '
+                    f"got {slow_replica!r}"
+                )
+            bad_keys = sorted(set(slow_replica) - {"rid", "sleep_s"})
+            if bad_keys:
+                raise ValueError(
+                    f"{ENV_VAR}: unknown replica_slow_at key(s) "
+                    f"{bad_keys}; valid: ['rid', 'sleep_s']"
+                )
+            rid = slow_replica.get("rid")
+            if isinstance(rid, bool) or not isinstance(rid, int) or rid < 0:
+                raise ValueError(
+                    f"{ENV_VAR}: replica_slow_at.rid must be a replica "
+                    f"id >= 0; got {rid!r}"
+                )
+            sleep_s = slow_replica.get("sleep_s")
+            if isinstance(sleep_s, bool) or not isinstance(
+                    sleep_s, (int, float)) or not math.isfinite(sleep_s) \
+                    or sleep_s <= 0:
+                raise ValueError(
+                    f"{ENV_VAR}: replica_slow_at.sleep_s must be a finite "
+                    f"number > 0 (a zero-second straggler is a silent "
+                    f"no-op); got {sleep_s!r}"
+                )
+            slow_replica = {"rid": rid, "sleep_s": float(sleep_s)}
         return cls(
             nan_at_step=nan,
             crash_in_save=crash,
@@ -459,6 +546,8 @@ class FaultPlan:
             sweep_job_kill_mid_save=job_kill_mid_save,
             serve_poison_requests=poison,
             serve_drift_shift=drift,
+            traffic_spike=spike,
+            replica_slow_at=slow_replica,
         )
 
     @classmethod
@@ -745,6 +834,47 @@ def maybe_poison_request(i: int, x: Any) -> Any:
     val = (float("nan"), float("inf"), 1e6)[int(i) % 3]
     x.reshape(-1)[::3] = val
     return x
+
+
+def traffic_spike() -> Optional[Dict[str, Any]]:
+    """The armed ``traffic_spike`` spec, or None.  Consulted by
+    serve_bench when it builds the open-loop arrival process: from
+    request index ``at_request`` onward the Poisson inter-arrival gaps
+    divide by ``factor``.  Persistent (NOT one-shot): a demand step is
+    the new steady state — the autoscaler, not the load generator,
+    decides when it stops hurting."""
+    plan = current()
+    if plan is None:
+        return None
+    return plan.traffic_spike
+
+
+def take_replica_slow(rid: int) -> Optional[Dict[str, Any]]:
+    """The per-replica fault plan (a ``DWT_FAULT_PLAN`` JSON object) the
+    fleet injects into replica ``rid``'s spawn env, or None.  One-shot
+    per arm — the ``take_sweep_job_fault`` pattern: if the straggler is
+    later SIGKILLed, its respawn runs clean, so what the test proves is
+    the router starving the straggler, not the prober reaping it."""
+    plan = current()
+    if plan is None or not plan.replica_slow_at:
+        return None
+    if int(plan.replica_slow_at["rid"]) != int(rid):
+        return None
+    spec = dict(plan.replica_slow_at)
+    plan.replica_slow_at = None
+    return {"replica_slow_at": spec}
+
+
+def maybe_replica_slow() -> None:
+    """Sleep the armed ``replica_slow_at.sleep_s`` once per call.
+    Called by the serve dispatcher at the top of every batch; inside a
+    replica the plan arrives via its own env (rid already matched by
+    the fleet), so the sleep is unconditional while armed.  Persistent:
+    a straggler is a steady state, not an event."""
+    plan = current()
+    if plan is None or not plan.replica_slow_at:
+        return
+    time.sleep(float(plan.replica_slow_at["sleep_s"]))
 
 
 def take_sweep_preempt(tag: str) -> bool:

@@ -39,6 +39,7 @@ import numpy as np
 
 from dwt_tpu import obs
 from dwt_tpu.data.loader import prefetch_to_device
+from dwt_tpu.resilience import inject
 from dwt_tpu.serve.batcher import (
     DEFAULT_BUCKETS,
     Future,
@@ -170,6 +171,12 @@ class _Dispatcher(threading.Thread):
         )
         try:
             for pb, x_dev in staged:
+                # Injected straggler (replica_slow_at): the sleep lands
+                # inside the batch's service time, so e2e latency and
+                # the balancer's drain-rate EWMA both see a genuinely
+                # slow replica — not a dead one (probes still answer
+                # 200, the heartbeat below still advances).
+                inject.maybe_replica_slow()
                 # ONE state snapshot per batch — the hot-swap contract.
                 # A swap landing mid-batch flips the engine's pointer,
                 # but this batch computes AND is attributed entirely on
@@ -612,6 +619,12 @@ class _Handler(DrainAwareHandler):
                 "draining": bool(self.draining.is_set()),
                 "buckets": list(self.client.engine.buckets),
                 "queued_items": self.client.batcher.queued_items,
+                # Load surfaced for the fleet's scale-down victim
+                # selection: queued + in-flight is what a SIGTERM would
+                # have to drain, so the autoscaler retires the replica
+                # for which that number is smallest.
+                "in_flight_batches": self.client._dispatcher.in_flight_count,
+                "served_requests": self.client.access_log.served_requests,
                 # Wedged-but-listening detection: a prober that sees this
                 # age far past the dispatcher poll period (~1 s) while
                 # queued_items > 0 should recycle the process even though

@@ -1,0 +1,861 @@
+"""SLO-driven autoscaling + heterogeneous weighted routing (ISSUE-20).
+
+Tier-1 (fast): the fake-clock decision matrix over the Autoscaler
+(pressure staircase with hysteresis + cooldown, flapping load inert,
+idle scale-down to min, respawn-budget blocking with forgiveness on
+demonstrated health, min/max pinning, kill-switch identity), the
+weighted router (2x drain rate -> ~2x traffic share, ejected -> zero,
+cold-start fleet-mean weights), session-affinity pinning across
+ejection/readmission/retirement, the front door's capacity-ETA
+Retry-After, the new fault kinds' strict validation, the serve_bench
+ramp helpers, and the obs_diff ramp extraction/direction rules.
+
+Slow-marked (tools/t1_budget.py discipline): the dwt-fleet CLI scaling
+end to end (2 -> up -> back to 2 under real HTTP load, clean drains,
+per-replica access-log trail) and the composed chaos proof (straggler
+replica + traffic spike + SIGKILL under live autoscaling, zero lost
+requests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from dwt_tpu.resilience import inject
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    inject.disarm()
+
+
+class _Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _replica(rid: int, port: int = 9500):
+    from dwt_tpu.fleet.balancer import Replica
+
+    return Replica(rid, "127.0.0.1", port + rid)
+
+
+def _scaler(rset, clock, events=None, spawn_fn=None, **kw):
+    from dwt_tpu.fleet.autoscale import Autoscaler
+
+    if spawn_fn is None:
+        def spawn_fn(rid):
+            return _replica(rid)
+    kw.setdefault("min_replicas", 2)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("pressure_hi", 4.0)
+    kw.setdefault("idle_lo", 0.5)
+    kw.setdefault("pressure_for_s", 2.0)
+    kw.setdefault("idle_for_s", 3.0)
+    kw.setdefault("cooldown_s", 4.0)
+    return Autoscaler(
+        rset, spawn_fn, clock=clock,
+        events=(events.append if events is not None else None), **kw
+    )
+
+
+def _load(rset, outstanding: int) -> None:
+    for r in rset.replicas:
+        if r.healthy and not r.retiring:
+            r.outstanding = outstanding
+
+
+# ------------------------------------------------- decision matrix (fake clock)
+
+def test_pressure_staircase_hysteresis_cooldown_and_max():
+    """Sustained pressure: hold for_s before the first scale-up, then a
+    cooldown-spaced staircase up to max_replicas, where the loop blocks
+    (one deduped scale_blocked event, not one per tick)."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock, events = _Clock(), []
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a = _scaler(rset, clock, events)
+    _load(rset, 10)  # load/replica = 10 > 4
+
+    seq = []
+    for t in range(12):
+        clock.t = float(t)
+        _load(rset, 10)
+        seq.append(a.tick())
+
+    # t=0 pending, fires once held >= for_s=2 -> first up at t=2.
+    assert [d.action for d in seq[:2]] == [None, None]
+    assert seq[2].action == "up" and seq[2].reason == "queue_pressure"
+    assert seq[2].target == 3
+    # Cooldown (4 s) blocks t=3..5; second up at t=6 reaches max=4.
+    assert {d.reason for d in seq[3:6]} == {"cooldown"}
+    assert seq[6].action == "up" and seq[6].target == 4
+    assert a.target == 4 and len(rset.replicas) == 4
+    # At max: blocked, reason at_max, persisting.
+    assert all(d.action == "blocked" and d.reason == "at_max"
+               for d in seq[11:])
+    ups = [e for e in events if e["kind"] == "scale_up"]
+    blocked = [e for e in events if e["kind"] == "scale_blocked"]
+    assert [e["target"] for e in ups] == [3, 4]
+    # Event dedupe: one per episode (cooldown, at_max), not per tick.
+    assert [e["reason"] for e in blocked] == ["cooldown", "at_max"]
+    # The metrics plane saw the staircase.
+    from dwt_tpu.obs.registry import get_registry
+
+    reg = get_registry()
+    assert reg.value("dwt_fleet_target_replicas") == 4
+    assert reg.value(
+        "dwt_fleet_scale_events_total",
+        {"direction": "up", "reason": "queue_pressure"},
+    ) >= 2
+
+
+def test_flapping_load_never_scales():
+    """Load oscillating through the threshold never holds for_s, so the
+    hysteresis yields NO action — raw-sample scaling is the bug."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock = _Clock()
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a = _scaler(rset, clock)
+    for t in range(10):
+        clock.t = float(t)
+        _load(rset, 10 if t % 2 == 0 else 0)
+        d = a.tick()
+        assert d.action is None, (t, d)
+    assert a.target == 2 and len(rset.replicas) == 2
+
+
+def test_idle_scales_down_to_min_loss_free():
+    """Sustained idle retires the least-loaded replica (SIGTERM drain,
+    slot removed only after a clean exit) down to min_replicas."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock, events = _Clock(), []
+    replicas = [_replica(0), _replica(1), _replica(2), _replica(3)]
+    replicas[0].outstanding = 1          # busiest: never the victim
+    # (fleet load = 1/4 = 0.25 < idle_lo: sustained idle)
+    rset = ReplicaSet(replicas, weighted=True)
+    a = _scaler(rset, clock, events)
+    assert a.target == 4
+
+    decisions = []
+    for t in range(16):
+        clock.t = float(t)
+        decisions.append(a.tick())
+    downs = [d for d in decisions if d.action == "down"]
+    assert len(downs) == 2 and a.target == 2
+    # Victims were the idle higher-rid replicas, busiest survived.
+    retired = [e["rid"] for e in events if e["kind"] == "scale_down"]
+    assert 0 not in retired and len(retired) == 2
+    clean = [e for e in events if e["kind"] == "scale_retired"]
+    assert len(clean) == 2 and all(e["clean"] for e in clean)
+    assert sorted(r.rid for r in rset.replicas) == sorted(
+        {0, 1, 2, 3} - set(retired)
+    )
+    # Pinned at min: idle keeps firing, the loop stays put.
+    clock.t = 30.0
+    d = a.tick()
+    assert d.action is None and d.reason in ("at_min", "steady")
+    assert a.target == 2
+
+
+def test_scale_down_victim_is_least_loaded():
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock, events = _Clock(), []
+    replicas = [_replica(0), _replica(1), _replica(2)]
+    replicas[0].last_health = {"queued_items": 0, "in_flight_batches": 0}
+    replicas[1].last_health = {"queued_items": 1, "in_flight_batches": 0}
+    # in_flight_batches counts toward victim load even though the
+    # sampled fleet load (queued + outstanding) ignores it.
+    replicas[2].last_health = {"in_flight_batches": 1}
+    rset = ReplicaSet(replicas, weighted=True)
+    a = _scaler(rset, clock, events, min_replicas=2, max_replicas=4)
+    for t in range(6):
+        clock.t = float(t)
+        a.tick()
+    down = [e for e in events if e["kind"] == "scale_down"]
+    assert len(down) == 1 and down[0]["rid"] == 0
+
+
+def test_respawn_budget_blocks_scale_up():
+    """A crash-looping spawn exhausts the scale-up budget and pressure
+    is then refused with reason=respawn_budget — load inflated by a
+    shrinking healthy denominator must not buy more doomed spawns."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock, events = _Clock(), []
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+
+    def bad_spawn(rid):
+        raise RuntimeError("boom")
+
+    a = _scaler(rset, clock, events, spawn_fn=bad_spawn,
+                scale_up_max=2, cooldown_s=0.0)
+    _load(rset, 10)
+    decisions = []
+    for t in range(0, 40):
+        clock.t = float(t)
+        _load(rset, 10)
+        decisions.append(a.tick())
+    # Spawns failed (scale_blocked spawn_failed events), budget spent,
+    # and the terminal state is blocked:respawn_budget with target flat.
+    fails = [e for e in events if e.get("reason") == "spawn_failed"]
+    assert len(fails) == 2  # scale_up_max attempts, never forgiven
+    assert a.target == 2 and len(rset.replicas) == 2
+    assert decisions[-1].action == "blocked"
+    assert decisions[-1].reason == "respawn_budget"
+
+
+def test_scale_up_budget_forgiven_on_healthy_replica():
+    """Successful scale-ups refund the budget once the new replica
+    proves healthy: legitimate growth never exhausts it."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock = _Clock()
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a = _scaler(rset, clock, scale_up_max=1, cooldown_s=1.0,
+                max_replicas=6)
+    _load(rset, 10)
+    ups = 0
+    for t in range(20):
+        clock.t = float(t)
+        _load(rset, 10)
+        if a.tick().action == "up":
+            ups += 1
+    # With scale_up_max=1 an unforgiving budget would allow ONE up ever;
+    # forgiveness (spawned replicas are healthy) allows the full climb.
+    assert ups >= 3 and a.target == 6
+
+
+def test_respawner_exhausted_slot_blocks_scale_up():
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock = _Clock()
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+
+    class _Respawner:
+        def exhausted_slots(self):
+            return [0]
+
+    a = _scaler(rset, clock, respawner=_Respawner())
+    _load(rset, 10)
+    last = None
+    for t in range(5):
+        clock.t = float(t)
+        _load(rset, 10)
+        last = a.tick()
+    assert last.action == "blocked" and last.reason == "respawn_budget"
+    assert a.target == 2
+
+
+def test_external_alert_is_pressure():
+    """A non-info alert fired by an operator-wired rule counts as
+    pressure (reason=alerts_firing); the loop's own rules echoed in the
+    shared gauge do not."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+    from dwt_tpu.obs.registry import get_registry
+
+    clock, events = _Clock(), []
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a = _scaler(rset, clock, events, pressure_for_s=2.0)
+    g = get_registry().gauge(
+        "dwt_alerts_firing", labelnames=("alertname", "severity")
+    )
+    decisions = []
+    for t in range(4):
+        clock.t = float(t)
+        # The loop's own evaluate clears the gauge each tick; a live
+        # external engine would re-stamp its series the same way.
+        g.labels(alertname="replica_dead", severity="critical").set(1)
+        decisions.append(a.tick())
+    g.clear()
+    # External alerts carry their own hysteresis (the external engine's
+    # for_s), so the loop reacts on the first tick, then cools down.
+    assert decisions[0].action == "up"
+    assert decisions[0].reason == "alerts_firing"
+    assert a.target == 3
+    # Own-rule echoes alone never count: fresh scaler, fire own name.
+    clock2 = _Clock()
+    rset2 = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a2 = _scaler(rset2, clock2)
+    for t in range(4):
+        clock2.t = float(t)
+        g.labels(alertname="fleet_pressure", severity="warning").set(1)
+        d2 = a2.tick()
+    g.clear()
+    # No scale-up on its own echo (idle at min is fine — load is 0).
+    assert d2.action is None and d2.reason in ("steady", "at_min")
+    assert a2.target == 2
+
+
+def test_autoscaler_bounds_validation():
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    rset = ReplicaSet([_replica(0)])
+    with pytest.raises(ValueError):
+        _scaler(rset, _Clock(), min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        _scaler(rset, _Clock(), min_replicas=0, max_replicas=2)
+
+
+def test_capacity_eta_and_retry_advice():
+    """advise_eta_s: None at steady state; the capacity ETA while in
+    post-scale-up cooldown and while pressure is pinned at max."""
+    from dwt_tpu.fleet.balancer import ReplicaSet
+
+    clock = _Clock()
+    rset = ReplicaSet([_replica(0), _replica(1)], weighted=True)
+    a = _scaler(rset, clock, max_replicas=3, interval_s=2.0,
+                ready_wait_seed_s=8.0)
+    assert a.advise_eta_s() is None
+    assert a.capacity_eta_s() == pytest.approx(10.0)  # interval + seed
+    _load(rset, 10)
+    for t in range(3):
+        clock.t = float(t)
+        _load(rset, 10)
+        d = a.tick()
+    assert d.action == "up"
+    # Fake clock: the spawn was instantaneous, EWMA absorbed wait=0.
+    assert a.ready_wait_ewma_s == pytest.approx(0.0)
+    assert a.advise_eta_s() == pytest.approx(a.capacity_eta_s())
+    # Past cooldown, still under pressure, now at max: ETA again.
+    for t in range(3, 12):
+        clock.t = float(t)
+        _load(rset, 10)
+        a.tick()
+    assert a.target == 3
+    assert a.advise_eta_s() == pytest.approx(a.capacity_eta_s())
+    # Load gone, alerts cleared: no ETA — the queue estimate stands.
+    _load(rset, 0)
+    clock.t = 13.0
+    a.tick()
+    assert a.advise_eta_s() is None
+
+
+# ----------------------------------------------------------- weighted router
+
+def test_weighted_routing_proportional_to_drain_rate():
+    """A replica draining 2x as fast takes ~2x the traffic: closed-loop
+    sim where each replica completes at its own (fixed) rate and every
+    arrival goes through the weighted pick."""
+    from dwt_tpu.fleet.balancer import Replica, ReplicaSet
+
+    fast, slow = Replica(0, "h", 1), Replica(1, "h", 2)
+    rset = ReplicaSet([fast, slow], weighted=True)
+    for r, rate in ((fast, 20.0), (slow, 10.0)):
+        r.rate_ewma = rate
+        r.served = 16  # past cold_min_served: weights are the EWMAs
+    rates = {0: 20.0, 1: 10.0}
+    picks = {0: 0, 1: 0}
+    credit = {0: 0.0, 1: 0.0}
+    arrive = 0.0
+    dt = 0.01
+    for _ in range(2000):  # 20 sim-seconds at offered = capacity
+        arrive += 30.0 * dt
+        while arrive >= 1.0:
+            arrive -= 1.0
+            r = rset.pick()
+            picks[r.rid] += 1
+        for r in (fast, slow):
+            if r.outstanding > 0:
+                credit[r.rid] += rates[r.rid] * dt
+                while credit[r.rid] >= 1.0 and r.outstanding > 0:
+                    credit[r.rid] -= 1.0
+                    # ok=False: count the completion without touching
+                    # the preset rate EWMAs.
+                    rset.release(r, ok=False)
+    share = picks[0] / (picks[0] + picks[1])
+    assert 2 / 3 * 0.8 <= share <= 2 / 3 * 1.2, picks
+
+
+def test_weighted_routing_ejected_gets_nothing_cold_gets_mean():
+    from dwt_tpu.fleet.balancer import Replica, ReplicaSet
+
+    a, b, c = (Replica(i, "h", i + 1) for i in range(3))
+    rset = ReplicaSet([a, b, c], weighted=True)
+    a.rate_ewma, a.served = 20.0, 16
+    b.rate_ewma, b.served = 10.0, 16
+    # c is cold (served < cold_min_served): weighs in at the fleet mean.
+    rset.eject(b, "test")
+    for _ in range(40):
+        r = rset.pick()
+        assert r.rid != 1  # ejected: weight 0 by construction
+        rset.release(r, ok=False)
+    # Warm straggler floor: a wedged-but-healthy replica keeps >= 5% of
+    # the fastest replica's weight, not 0 (the prober, not the router,
+    # decides who leaves the fleet).
+    rset.readmit(b)
+    b.rate_ewma = 1e-9
+    w = rset._weight_locked(b, [a, b])
+    assert w == pytest.approx(0.05 * 20.0)
+
+
+def test_unweighted_pick_identical_and_cold_weighted_degenerates():
+    """--no-autoscale identity: weighted=False is the legacy router bit
+    for bit; weighted=True with an all-cold fleet (no EWMAs yet) makes
+    the same picks the legacy router makes."""
+    from dwt_tpu.fleet.balancer import Replica, ReplicaSet
+
+    def run(weighted, with_rates):
+        rs = [Replica(i, "h", i + 1) for i in range(3)]
+        if with_rates:
+            for r, rate in zip(rs, (30.0, 10.0, 20.0)):
+                r.rate_ewma, r.served = rate, 16
+        rset = ReplicaSet(rs, weighted=weighted)
+        seq = []
+        for i in range(12):
+            r = rset.pick()
+            seq.append(r.rid)
+            if i % 3 == 2:  # drain all three, back to equal outstanding
+                for x in rs:
+                    while x.outstanding:
+                        rset.release(x, ok=False)
+        return seq
+
+    # All-cold fleets: weighting has no signal, degenerates to legacy.
+    assert run(True, False) == run(False, False)
+    # With rate signal, weighted=False STILL ignores it (the pin).
+    assert run(False, True) == run(False, False)
+
+
+def test_session_affinity_pins_survive_ejection_cycle():
+    from dwt_tpu.fleet.balancer import Replica, ReplicaSet
+
+    rs = [Replica(i, "h", i + 1) for i in range(3)]
+    rset = ReplicaSet(rs, weighted=True, session_affinity=True)
+    by_rid = {r.rid: r for r in rs}
+
+    def owner(key):
+        r = rset.pick(session_key=key)
+        rset.release(r, ok=False)
+        return r.rid
+
+    # Stable pin, load notwithstanding.
+    pin = owner("user-42")
+    rs[pin].outstanding = 50
+    assert all(owner("user-42") == pin for _ in range(5))
+    rs[pin].outstanding = 0
+    # Keys spread across the ring (vnodes doing their job).
+    owners = {owner(f"user-{i}") for i in range(64)}
+    assert len(owners) > 1
+    # Ejected owner: the key degrades to a weighted pick (never the
+    # ejected replica); readmission restores the SAME pin (the ring is
+    # membership-keyed, not health-keyed).
+    rset.eject(by_rid[pin], "test")
+    assert all(owner("user-42") != pin for _ in range(5))
+    rset.readmit(by_rid[pin])
+    assert owner("user-42") == pin
+    # Retirement remaps the arc for good.
+    rset.retire(by_rid[pin])
+    new = owner("user-42")
+    assert new != pin
+    rset.remove(by_rid[pin])
+    assert owner("user-42") == new
+
+
+# ------------------------------------------------ front door Retry-After ETA
+
+def test_front_door_retry_after_uses_capacity_eta():
+    """With no healthy replica, the 503's Retry-After reflects the
+    autoscaler's expected-capacity ETA instead of the fixed default —
+    and without an autoscaler the legacy default stands."""
+    from http.server import ThreadingHTTPServer
+
+    from dwt_tpu.fleet.balancer import Replica, ReplicaSet, make_handler
+    from dwt_tpu.serve.server import HttpServeClient
+
+    class _StubScaler:
+        target = 1
+
+        def advise_eta_s(self):
+            return 7.0
+
+        def note_latency(self, ms):
+            pass
+
+    def _front(autoscaler):
+        r = Replica(0, "127.0.0.1", 1)  # nothing listening
+        rset = ReplicaSet([r])
+        rset.eject(r, "test")
+        draining = threading.Event()
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0),
+            make_handler(rset, draining, autoscaler=autoscaler),
+        )
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd
+
+    for scaler, want_ms in ((_StubScaler(), 7000), (None, 1000)):
+        httpd = _front(scaler)
+        client = HttpServeClient(
+            "127.0.0.1", httpd.server_address[1], timeout=10.0
+        )
+        try:
+            status, payload = client.request_json(
+                "POST", "/infer", {"inputs": [[0.0]]}
+            )
+            assert status == 503
+            assert payload["retry_after_ms"] == want_ms
+            status, health = client.healthz()
+            assert health["autoscale"] == (scaler is not None)
+            assert health["target_replicas"] == 1
+        finally:
+            client.close()
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ------------------------------------------------------- fault-kind parsing
+
+def test_traffic_spike_and_replica_slow_validation():
+    from dwt_tpu.resilience.inject import FaultPlan
+
+    plan = FaultPlan.from_spec({
+        "traffic_spike": {"at_request": 10, "factor": 4.0},
+        "replica_slow_at": {"rid": 1, "sleep_s": 0.05},
+    })
+    assert plan.traffic_spike == {"at_request": 10, "factor": 4.0}
+    assert plan.replica_slow_at == {"rid": 1, "sleep_s": 0.05}
+    # at_request defaults to 0 (whole run spiked).
+    assert FaultPlan.from_spec(
+        {"traffic_spike": {"factor": 2.0}}
+    ).traffic_spike["at_request"] == 0
+    for bad in (
+        {"traffic_spike": {"factor": 1.0}},          # identity no-op
+        {"traffic_spike": {"factor": 0.0}},
+        {"traffic_spike": {"factor": -2.0}},
+        {"traffic_spike": {}},                        # no factor
+        {"traffic_spike": {"factor": 2.0, "nope": 1}},
+        {"traffic_spike": {"at_request": -1, "factor": 2.0}},
+        {"replica_slow_at": {"rid": 0}},              # no sleep_s
+        {"replica_slow_at": {"sleep_s": 0.1}},        # no rid
+        {"replica_slow_at": {"rid": -1, "sleep_s": 0.1}},
+        {"replica_slow_at": {"rid": 0, "sleep_s": 0.0}},
+        {"replica_slow_at": {"rid": 0, "sleep_s": 0.1, "x": 1}},
+    ):
+        with pytest.raises(ValueError):
+            FaultPlan.from_spec(bad)
+
+
+def test_take_replica_slow_one_shot_and_rid_match():
+    inject.arm(inject.FaultPlan.from_spec(
+        {"replica_slow_at": {"rid": 2, "sleep_s": 0.05}}
+    ))
+    assert inject.take_replica_slow(0) is None   # wrong rid: untouched
+    got = inject.take_replica_slow(2)
+    assert got == {"replica_slow_at": {"rid": 2, "sleep_s": 0.05}}
+    assert inject.take_replica_slow(2) is None   # one-shot per arm
+
+
+def test_apply_spike_scales_poisson_gaps():
+    from serve_bench import _apply_spike
+
+    gaps = np.ones(10, np.float64)
+    _apply_spike(gaps)  # disarmed: no-op
+    assert np.all(gaps == 1.0)
+    inject.arm(inject.FaultPlan.from_spec(
+        {"traffic_spike": {"at_request": 4, "factor": 2.0}}
+    ))
+    _apply_spike(gaps)
+    assert np.all(gaps[:4] == 1.0) and np.all(gaps[4:] == 0.5)
+
+
+# ------------------------------------------------- ramp helpers + obs_diff
+
+def test_ramp_parse_and_schedule():
+    from serve_bench import _parse_ramp, _ramp_schedule
+
+    assert _parse_ramp("100:400:5") == (100.0, 400.0, 5.0)
+    assert _ramp_schedule(100.0, 400.0) == [100.0, 200.0, 400.0]
+    assert _ramp_schedule(100.0, 500.0) == [100.0, 200.0, 400.0, 500.0]
+    assert _ramp_schedule(100.0, 100.0) == [100.0]
+    for bad in ("100:400", "0:400:5", "400:100:5", "100:400:0", "x:y:z"):
+        with pytest.raises(ValueError):
+            _parse_ramp(bad)
+
+
+def test_obs_diff_ramp_directions_and_extraction():
+    from obs_diff import direction_of, extract_metrics
+
+    assert direction_of("ramp_fast_share") == "up"
+    assert direction_of("ramp_shed_total") == "down"
+    assert direction_of("ramp_lost_total") == "down"
+    assert direction_of("ramp_scale_lag_s") == "down"
+    assert direction_of("ramp_post_scale_e2e_ms_p99") == "down"
+    rec = {
+        "kind": "serve_ramp", "ramp": "100:400:5",
+        "ramp_scale_lag_s": 3.2, "ramp_shed_total": 4,
+        "ramp_lost_total": 0, "ramp_e2e_ms_p50": 2.0,
+        "ramp_e2e_ms_p99": 9.0, "ramp_post_scale_e2e_ms_p99": 5.0,
+        "ramp_fast_share": 0.66, "replica_requests": {"0": 10},
+    }
+    got = extract_metrics([rec])
+    assert got["ramp_scale_lag_s"] == 3.2
+    assert got["ramp_fast_share"] == 0.66
+    assert got["ramp_lost_total"] == 0.0
+    assert "replica_requests" not in got
+
+
+def test_fleet_cli_flags_parse_and_validate():
+    from dwt_tpu.fleet.balancer import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["--replicas", "2", "--max_replicas", "4",
+                         "--scale_interval_s", "0.5",
+                         "--session_affinity"])
+    assert args.max_replicas == 4 and not args.no_autoscale
+    assert args.session_affinity
+    assert args.min_replicas is None  # defaults to --replicas in main()
+    args = p.parse_args(["--no-autoscale"])
+    assert args.no_autoscale
+
+
+# ---------------------------------------------------------------- slow tier
+
+def _post(port, body, timeout=60):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/infer", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, data, resp.getheader("X-DWT-Replica")
+    finally:
+        conn.close()
+
+
+def _healthz(port):
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10
+    ) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_fleet_cli_autoscales_up_and_back_down(tmp_path):
+    """Acceptance: dwt-fleet under real HTTP load scales 2 -> 3+ (queue
+    pressure), then back to 2 on sustained idle with exit-0 drains, and
+    every replica — including the retired ones — left a parseable
+    per-replica access-log trail."""
+    access = str(tmp_path / "access.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.fleet.balancer",
+         "--replicas", "2", "--min_replicas", "2", "--max_replicas", "3",
+         "--port", "0", "--health_interval_s", "0.3",
+         "--scale_interval_s", "0.5", "--scale_pressure", "1.5",
+         "--scale_pressure_for_s", "1", "--scale_idle", "0.2",
+         "--scale_idle_for_s", "3", "--scale_cooldown_s", "1", "--",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2", "--access_log", access],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["kind"] == "fleet_ready" and ready["autoscale"]
+        port = ready["port"]
+        body = json.dumps(
+            {"inputs": np.zeros((4, 28, 28, 1)).tolist()}
+        ).encode()
+        for _ in range(4):  # warm both replicas' buckets
+            assert _post(port, body)[0] == 200
+
+        stop_load = threading.Event()
+        statuses = []
+
+        def _loadgen():
+            while not stop_load.is_set():
+                try:
+                    statuses.append(_post(port, body)[0])
+                except Exception:
+                    statuses.append(None)
+
+        threads = [threading.Thread(target=_loadgen, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        # Pressure (8 in flight / 2 replicas > 1.5) must scale up; the
+        # spawn blocks the control loop while the new replica compiles.
+        deadline = time.monotonic() + 180
+        scaled = False
+        while time.monotonic() < deadline:
+            h = _healthz(port)
+            if h["target_replicas"] >= 3:
+                scaled = True
+                break
+            time.sleep(0.3)
+        assert scaled, "autoscaler never scaled up under pressure"
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=90)
+        assert None not in statuses, "a request got no HTTP answer"
+
+        # Idle: back down to min with clean retirements.
+        deadline = time.monotonic() + 120
+        settled = False
+        while time.monotonic() < deadline:
+            h = _healthz(port)
+            if (h["target_replicas"] == 2
+                    and len(h["replicas"]) == 2
+                    and h["healthy_replicas"] == 2):
+                settled = True
+                break
+            time.sleep(0.5)
+        assert settled, "fleet never settled back to min_replicas"
+        # Still serving at min.
+        assert _post(port, body)[0] == 200
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        out_lines = proc.stdout.read().splitlines()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    events = [json.loads(line) for line in out_lines if line.strip()]
+    kinds = [e["kind"] for e in events]
+    assert "scale_up" in kinds and "scale_down" in kinds
+    retired = [e for e in events if e["kind"] == "scale_retired"]
+    assert retired and all(e["clean"] for e in retired)
+    summary = events[-1]
+    assert summary["kind"] == "fleet_summary"
+    assert summary["unclean_drains"] == 0
+    # Per-replica access logs: every replica that ever served left its
+    # own parseable trail (rid 0, 1, and the scaled-up one).
+    trails = [f for f in os.listdir(tmp_path)
+              if f.startswith("access.jsonl.r")]
+    assert len(trails) >= 3, trails
+    for f in trails:
+        for line in open(tmp_path / f):
+            json.loads(line)
+
+
+@pytest.mark.slow
+def test_fleet_composed_chaos_spike_straggler_sigkill(tmp_path):
+    """The composed proof: a straggler replica (replica_slow_at), an
+    offered-rate spike, and a SIGKILL mid-load — under live autoscaling
+    with respawn enabled the fleet returns to target strength, no
+    request is lost (every submit gets an HTTP answer), and the access
+    trail stays intact."""
+    access = str(tmp_path / "access.jsonl")
+    env = dict(os.environ)
+    env[inject.ENV_VAR] = json.dumps(
+        {"replica_slow_at": {"rid": 1, "sleep_s": 0.05}}
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dwt_tpu.fleet.balancer",
+         "--replicas", "2", "--min_replicas", "2", "--max_replicas", "3",
+         "--port", "0", "--health_interval_s", "0.3",
+         "--scale_interval_s", "0.5", "--scale_pressure", "2",
+         "--scale_pressure_for_s", "1", "--scale_idle", "0.05",
+         "--scale_idle_for_s", "60", "--scale_cooldown_s", "1",
+         "--respawn_max", "2", "--respawn_backoff_s", "0.2", "--",
+         "--init_random", "--model", "lenet", "--buckets", "1,4",
+         "--max_batch_delay_ms", "2", "--access_log", access],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        port = ready["port"]
+        body = json.dumps(
+            {"inputs": np.zeros((2, 28, 28, 1)).tolist()}
+        ).encode()
+        for _ in range(4):
+            assert _post(port, body)[0] == 200
+
+        # The bench-side spike arms IN THIS process: gaps after request
+        # 100 shrink 3x — the same code path serve_bench runs.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from serve_bench import _apply_spike
+
+        inject.arm(inject.FaultPlan.from_spec(
+            {"traffic_spike": {"at_request": 100, "factor": 3.0}}
+        ))
+        rng = np.random.default_rng(0)
+        gaps = rng.exponential(1.0 / 40.0, size=400)
+        _apply_spike(gaps)
+        arrivals = np.cumsum(gaps)
+
+        lost = [0]
+        lock = threading.Lock()
+        threads = []
+
+        def _fire():
+            try:
+                _post(port, body, timeout=120)
+            except Exception:
+                with lock:
+                    lost[0] += 1
+
+        killed = [False]
+        t0 = time.monotonic()
+        for i, t_arr in enumerate(arrivals):
+            delay = t0 + t_arr - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if i == 150 and not killed[0]:
+                # SIGKILL the straggler mid-spike; the respawner must
+                # bring the slot back while the autoscaler reacts to
+                # the pressure.
+                h = _healthz(port)
+                victim = next(r for r in h["replicas"]
+                              if r["rid"] == 1 and r["pid"])
+                os.kill(victim["pid"], signal.SIGKILL)
+                killed[0] = True
+            th = threading.Thread(target=_fire, daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=180)
+        assert killed[0]
+        assert lost[0] == 0, f"{lost[0]} requests got no HTTP answer"
+
+        # The fleet recovers to target strength (respawn + autoscale).
+        deadline = time.monotonic() + 120
+        h = {}
+        while time.monotonic() < deadline:
+            h = _healthz(port)
+            if h["healthy_replicas"] >= h["target_replicas"] >= 2:
+                break
+            time.sleep(0.5)
+        assert h["healthy_replicas"] >= 2, h
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # Intact per-replica trail: every access file still parses whole.
+    trails = [f for f in os.listdir(tmp_path)
+              if f.startswith("access.jsonl.r")]
+    assert len(trails) >= 2, trails
+    for f in trails:
+        for line in open(tmp_path / f):
+            json.loads(line)

@@ -549,9 +549,24 @@ def test_fleet_metrics_aggregation_with_ejected_replica():
         r1 = Replica(1, "127.0.0.1", r1_srv.server_address[1])
         rset = ReplicaSet([r0, r1])
         rset.eject(r1, "test: down")  # ejected replica contributes nothing
+        # An (unstarted) autoscaler over the same rset: its gauges and
+        # event counter must ride the aggregated exposition.  Force one
+        # blocked decision so the labeled counter has a series.
+        from dwt_tpu.fleet.autoscale import Autoscaler
+
+        clock = _Clock()
+        scaler = Autoscaler(
+            rset, lambda rid: None, min_replicas=1, max_replicas=2,
+            pressure_for_s=0.0, clock=clock,
+        )
+        r0.outstanding = 50  # pressure at max -> blocked:at_max
+        d = scaler.tick()
+        r0.outstanding = 0
+        assert (d.action, d.reason) == ("blocked", "at_max")
         draining = threading.Event()
         front = ThreadingHTTPServer(
-            ("127.0.0.1", 0), make_handler(rset, draining)
+            ("127.0.0.1", 0), make_handler(rset, draining,
+                                           autoscaler=scaler)
         )
         threading.Thread(target=front.serve_forever, daemon=True).start()
         try:
@@ -573,6 +588,14 @@ def test_fleet_metrics_aggregation_with_ejected_replica():
     assert 'replica="1"' not in text
     assert "dwt_fleet_healthy_replicas 1" in text
     assert 'dwt_fleet_ejections_total{rid="1"} ' in text
+    # Autoscaler series ride the same exposition: the target gauge and
+    # the labeled lifecycle-event counter (one blocked:at_max tick).
+    assert "dwt_fleet_target_replicas 2" in text
+    # (presence, not count: the counter is process-global and other
+    # autoscaler tests in the same session feed the same series)
+    assert ('dwt_fleet_scale_events_total{direction="blocked",'
+            'reason="at_max"}' in text)
+    assert "dwt_fleet_load_per_replica" in text
 
 
 def test_respawner_backoff_fake_clock():

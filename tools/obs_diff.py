@@ -54,6 +54,13 @@ _DIRECTION_RULES: List[Tuple[str, str]] = [
     (r"(imgs_per_s|imgs_per_sec|steps_per_s|per_sec)", "up"),
     (r"(accuracy|mfu)$", "up"),
     (r"(speedup|reduction_x|dedup_x)", "up"),
+    # serve_bench --ramp (fleet autoscaling probe): fast-replica traffic
+    # share rises as weighted routing engages; sheds, losses, and the
+    # autoscaler's reaction lag are all lower-is-better.
+    (r"_share$", "up"),
+    (r"_shed_total$", "down"),
+    (r"_scale_lag_s$", "down"),
+    (r"_lost_total$", "down"),
     # Reduced-precision A/Bs: whitener_bf16_x_<backend> is the
     # bf16-over-f32 throughput ratio of one whitener backend (higher =
     # bf16 buys more), from tools/whitener_bench.py --compute_dtype.
@@ -236,6 +243,19 @@ def _extract_serve_bench(rec: dict, out: Dict[str, float]) -> None:
             out[f"{prefix}.{key}"] = v
 
 
+def _extract_serve_ramp(rec: dict, out: Dict[str, float]) -> None:
+    """tools/serve_bench.py --ramp record: the fleet-level autoscaling
+    probe.  Keys land unprefixed (one ramp per JSONL run) so the
+    direction rules (``_share`` up, ``_shed_total``/``_scale_lag_s``/
+    ``_lost_total`` down) pick them up directly."""
+    for key in ("ramp_scale_lag_s", "ramp_shed_total", "ramp_lost_total",
+                "ramp_e2e_ms_p50", "ramp_e2e_ms_p99",
+                "ramp_post_scale_e2e_ms_p99", "ramp_fast_share"):
+        v = _num(rec.get(key))
+        if v is not None:
+            out[key] = v
+
+
 def _extract_obs_report(rec: dict, out: Dict[str, float]) -> None:
     for pid, proc in (rec.get("processes") or {}).items():
         train = proc.get("train")
@@ -278,6 +298,8 @@ def extract_metrics(records: List[dict]) -> Dict[str, float]:
             _extract_ckpt_bench(rec, out)
         elif kind == "serve_bench":
             _extract_serve_bench(rec, out)
+        elif kind == "serve_ramp":
+            _extract_serve_ramp(rec, out)
         elif kind == "shard_bench":
             _extract_shard_bench(rec, out)
         elif kind == "whitener_bench":

@@ -83,6 +83,25 @@ def _build_client(args):
     return client, engine.input_shape
 
 
+def _apply_spike(gaps) -> None:
+    """Fold an armed ``traffic_spike`` fault into the Poisson gaps.
+
+    A spike is a STEP in the offered rate, not a burst of extra
+    requests: from ``at_request`` onward every inter-arrival gap is
+    divided by ``factor`` (rate × factor) before the cumsum, so the
+    arrival process stays Poisson — just faster — and the request count
+    is unchanged (the open-loop contract still decides what sheds).
+    No-op when no plan is armed.
+    """
+    from dwt_tpu.resilience import inject
+
+    spike = inject.traffic_spike()
+    if not spike:
+        return
+    at = min(int(spike["at_request"]), len(gaps))
+    gaps[at:] /= float(spike["factor"])
+
+
 def run_load(client, input_shape, offered: float, seconds: float,
              request_n: int, seed: int = 0,
              reloader=None, reload_every_s: float = 0.0,
@@ -116,6 +135,7 @@ def run_load(client, input_shape, offered: float, seconds: float,
     req_rate = offered / request_n
     n_requests = max(1, int(round(req_rate * seconds)))
     gaps = rng.exponential(1.0 / req_rate, size=n_requests)
+    _apply_spike(gaps)
     arrivals = np.cumsum(gaps)
     x = rng.normal(size=(request_n,) + tuple(input_shape)).astype(np.float32)
 
@@ -279,6 +299,200 @@ def run_load(client, input_shape, offered: float, seconds: float,
     return record
 
 
+def _parse_ramp(spec: str):
+    """``lo:hi:step_s`` → (lo, hi, step_s), strictly validated."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"--ramp wants lo:hi:step_s, got {spec!r}")
+    lo, hi, step_s = (float(v) for v in parts)
+    if not (lo > 0 and hi >= lo and step_s > 0):
+        raise ValueError(
+            f"--ramp needs 0 < lo <= hi and step_s > 0, got {spec!r}"
+        )
+    return lo, hi, step_s
+
+
+def _ramp_schedule(lo: float, hi: float):
+    """Geometric (doubling) rate steps lo → hi, hi always included."""
+    rates, r = [], lo
+    while r < hi:
+        rates.append(r)
+        r *= 2.0
+    rates.append(hi)
+    return rates
+
+
+def run_ramp(args) -> dict:
+    """Open-loop HTTP ramp against a live ``dwt-fleet`` front door.
+
+    The sweep arm measures the engine in-process; this arm measures the
+    FLEET — the balancer, its weighted routing, and the autoscaler's
+    reaction time are the objects under test, so requests go over real
+    HTTP and the fleet's own ``/healthz`` is polled for the first
+    ``target_replicas`` increase.  The offered rate steps geometrically
+    ``lo → hi`` (each level held ``step_s``), arrivals Poisson within
+    each level and honored regardless of how the fleet is doing.
+
+    One ``serve_ramp`` record: ``ramp_scale_lag_s`` (ramp start → first
+    observed scale-up), ``ramp_shed_total`` (429/503 answers),
+    ``ramp_lost_total`` (no HTTP answer at all — the loss-free contract
+    says this stays 0 even while replicas retire), overall and
+    post-scale-up served tails, and ``ramp_fast_share`` (largest
+    per-replica share of served requests, off the balancer's
+    ``X-DWT-Replica`` stamp — the weighted-routing probe).
+    """
+    import http.client
+    import queue
+    import urllib.parse
+
+    url = args.target_url
+    if "//" not in url:
+        url = "http://" + url
+    parsed = urllib.parse.urlsplit(url)
+    host, port = parsed.hostname, parsed.port or 80
+
+    input_shape = tuple(
+        int(v) for v in str(args.input_shape).split(",") if v.strip()
+    )
+    lo, hi, step_s = _parse_ramp(args.ramp)
+    rates = _ramp_schedule(lo, hi)
+    rng = np.random.default_rng(args.seed)
+    x = rng.normal(
+        size=(args.request_n,) + input_shape
+    ).astype(np.float32)
+    body = json.dumps({"inputs": x.tolist()}).encode()
+
+    results = []  # (t_submit_rel, e2e_ms|None, status|None, rid|None)
+    results_lock = threading.Lock()
+    jobs: "queue.Queue" = queue.Queue()
+    done = threading.Event()
+    t0 = time.perf_counter()
+
+    def _worker():
+        conn = None
+        while True:
+            job = jobs.get()
+            if job is None:
+                return
+            t_due = job
+            t_send = time.perf_counter()
+            status, rid, e2e_ms = None, None, None
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=30.0
+                    )
+                conn.request("POST", "/infer", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+                rid = resp.getheader("X-DWT-Replica")
+                e2e_ms = (time.perf_counter() - t_send) * 1e3
+            except Exception:
+                # A dead kept-alive conn or a mid-request failure: the
+                # request got NO answer — that is exactly what
+                # ramp_lost_total counts.  Fresh conn for the next one.
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:
+                    pass
+                conn = None
+            with results_lock:
+                results.append((t_due - t0, e2e_ms, status, rid))
+
+    # Time-to-first-scale-up watcher: the fleet's own target_replicas
+    # gauge (via /healthz) is the autoscaler's decision stamp.
+    baseline_target = None
+    scale_up_t = [None]
+
+    def _watch():
+        nonlocal baseline_target
+        while not done.wait(0.1):
+            try:
+                conn = http.client.HTTPConnection(host, port, timeout=2.0)
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                h = json.loads(resp.read() or b"{}")
+                conn.close()
+            except Exception:
+                continue
+            tgt = h.get("target_replicas")
+            if tgt is None:
+                continue
+            if baseline_target is None:
+                baseline_target = tgt
+            elif tgt > baseline_target and scale_up_t[0] is None:
+                scale_up_t[0] = time.perf_counter() - t0
+
+    workers = [
+        threading.Thread(target=_worker, daemon=True)
+        for _ in range(args.ramp_workers)
+    ]
+    for w in workers:
+        w.start()
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+
+    n_sent = 0
+    for rate in rates:
+        req_rate = rate / args.request_n
+        n = max(1, int(round(req_rate * step_s)))
+        gaps = np.random.default_rng(args.seed + n_sent).exponential(
+            1.0 / req_rate, size=n
+        )
+        t_level = time.perf_counter()
+        for t_arr in np.cumsum(gaps):
+            delay = t_level + t_arr - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            jobs.put(time.perf_counter())
+            n_sent += 1
+    for _ in workers:
+        jobs.put(None)
+    for w in workers:
+        w.join(timeout=120.0)
+    done.set()
+    watcher.join(timeout=10.0)
+
+    from dwt_tpu.utils.metrics import percentile_summary
+
+    served = [(t, ms, rid) for t, ms, s, rid in results if s == 200]
+    shed = sum(1 for _, _, s, _ in results if s in (429, 503))
+    lost = sum(1 for _, _, s, _ in results if s is None)
+    per_replica = {}
+    for _, _, rid in served:
+        per_replica[str(rid)] = per_replica.get(str(rid), 0) + 1
+    record = {
+        "kind": "serve_ramp",
+        "ramp": args.ramp,
+        "ramp_rates_imgs_per_s": [round(r, 1) for r in rates],
+        "requests": len(results),
+        "served": len(served),
+        "ramp_shed_total": shed,
+        "ramp_lost_total": lost,
+        "replica_requests": per_replica,
+        **percentile_summary([ms for _, ms, _ in served], (50.0, 99.0),
+                             prefix="ramp_e2e_ms_p"),
+    }
+    if per_replica and len(served) > 0:
+        record["ramp_fast_share"] = round(
+            max(per_replica.values()) / len(served), 4
+        )
+    if scale_up_t[0] is not None:
+        record["ramp_scale_lag_s"] = round(scale_up_t[0], 2)
+        # "Post-scale steady state": requests submitted once the new
+        # replica had ~1 s to come up — did adding capacity actually
+        # pull the tail back down?
+        settle = scale_up_t[0] + 1.0
+        record.update(percentile_summary(
+            [ms for t, ms, _ in served if t >= settle], (99.0,),
+            prefix="ramp_post_scale_e2e_ms_p",
+        ))
+    return record
+
+
 def main(argv=None) -> int:
     from dwt_tpu.serve.server import build_parser
 
@@ -302,9 +516,34 @@ def main(argv=None) -> int:
     p.add_argument("--swap_window_s", type=float, default=0.5,
                    help="window after each swap attributed to it in the "
                         "swap-vs-steady latency split")
+    p.add_argument("--ramp", default="",
+                   help="lo:hi:step_s — open-loop HTTP ramp against a "
+                        "live dwt-fleet front door (--target_url): rate "
+                        "doubles lo→hi, each level held step_s; emits "
+                        "one serve_ramp record with scale-lag / shed / "
+                        "lost / per-replica share (the autoscaler + "
+                        "weighted-routing probe)")
+    p.add_argument("--target_url", default="",
+                   help="fleet front-door URL for --ramp "
+                        "(e.g. http://127.0.0.1:8100)")
+    p.add_argument("--ramp_workers", type=int, default=32,
+                   help="HTTP worker threads for --ramp (each keeps a "
+                        "persistent connection)")
+    p.add_argument("--input_shape", default="28,28,1",
+                   help="input image shape for --ramp payloads (ramp "
+                        "mode drives a remote fleet, no local engine)")
     args = p.parse_args(argv)
     if args.reload_every > 0 and not args.ckpt_dir:
         p.error("--reload_every needs --ckpt_dir (the watched directory)")
+    if args.ramp:
+        if not args.target_url:
+            p.error("--ramp needs --target_url (the fleet front door)")
+        try:
+            _parse_ramp(args.ramp)
+        except ValueError as e:
+            p.error(str(e))
+        print(json.dumps(run_ramp(args)), flush=True)
+        return 0
 
     # Inherited --obs_trace (server parser): every bench run can emit a
     # bucket-attributed serving trace for tools/obs_report.py.
